@@ -1,0 +1,34 @@
+"""byteps_tpu — a TPU-native distributed training communication framework
+with the capabilities of BytePS (Horovod-compatible push_pull API, tensor
+partitioning, priority/credit scheduling, hierarchical ICI+DCN reduction,
+async parameter-server mode) designed from scratch on JAX/XLA/pjit/Pallas.
+
+Top-level module re-exports the Horovod-compatible API (reference
+``byteps/torch/__init__.py``, ``byteps/tensorflow/__init__.py``):
+
+    import byteps_tpu as bps
+    bps.init()
+    g = bps.push_pull(g, average=True)
+    bps.broadcast_parameters(params, root_rank=0)
+"""
+
+from .api import (  # noqa: F401
+    Compression,
+    DistributedOptimizer,
+    broadcast,
+    broadcast_parameters,
+    broadcast_optimizer_state,
+    declare,
+    init,
+    local_rank,
+    local_size,
+    poll,
+    push_pull,
+    push_pull_async,
+    rank,
+    shutdown,
+    size,
+    synchronize,
+)
+
+__version__ = "0.1.0"

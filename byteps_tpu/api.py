@@ -1,0 +1,287 @@
+"""Horovod-compatible public API.
+
+Mirrors the surface of the reference's framework plugins (SURVEY.md §2.2):
+``init / shutdown / rank / size / local_rank / local_size`` (reference
+operations.cc:28-91), ``push_pull(_async) / poll / synchronize / declare``
+(torch/ops.py:96-218), ``broadcast_parameters /
+broadcast_optimizer_state`` (torch/__init__.py:234-381) and
+``DistributedOptimizer`` — re-expressed for single-controller JAX:
+
+  * ``rank``/``size`` — in multi-process runs a "worker" is a process
+    (``jax.process_index/count``); in single-process runs with a multi-device
+    mesh the *devices* of the data axes are the workers, and eager
+    ``push_pull`` takes contributions stacked along a leading worker axis.
+  * inside a jitted/shard_mapped training step, ``push_pull`` with an
+    ``axis_name`` degenerates to the bucketed collective path
+    (parallel/collectives.py) — that is the hot path the reference drives
+    from its C++ core loops.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .common import logging as bps_log
+from .common.config import get_config, reset_config
+from .engine import dispatcher as _dispatcher
+from .ops.compression import Compression, Compressor, NoneCompressor
+from .parallel import collectives as _collectives
+from .parallel import mesh as _mesh_mod
+
+
+class _GlobalState:
+    def __init__(self):
+        self.initialized = False
+        self.mesh = None
+        self.reduce_axes: List[str] = []
+        self.lock = threading.Lock()
+
+
+_state = _GlobalState()
+
+
+def init(
+    mesh: Optional[jax.sharding.Mesh] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    mesh_shape: Optional[dict] = None,
+) -> None:
+    """Initialize byteps_tpu (reference byteps_init, operations.cc:30-75).
+
+    Builds (or adopts) the global device mesh and starts the eager engine.
+    Safe to call more than once (idempotent, like the reference's
+    ``_init_done`` latch).
+    """
+    with _state.lock:
+        if _state.initialized:
+            return
+        cfg = get_config()
+        if mesh is None:
+            shape = mesh_shape or _mesh_mod.parse_mesh_shape(cfg.mesh_shape)
+            mesh = _mesh_mod.build_mesh(devices=devices, mesh_shape=shape or None)
+        _state.mesh = mesh
+        _state.reduce_axes = _mesh_mod.reduce_axes(mesh)
+        _dispatcher.start_engine(mesh, _state.reduce_axes)
+        _state.initialized = True
+        bps_log.info(
+            "byteps_tpu initialized: mesh %s, reduce axes %s",
+            dict(mesh.shape), _state.reduce_axes,
+        )
+
+
+def shutdown() -> None:
+    """Reference byteps_shutdown (operations.cc:77-80)."""
+    with _state.lock:
+        if not _state.initialized:
+            return
+        _dispatcher.stop_engine()
+        _state.mesh = None
+        _state.reduce_axes = []
+        _state.initialized = False
+        reset_config()
+
+
+def _require_init() -> None:
+    if not _state.initialized:
+        init()
+
+
+def mesh() -> jax.sharding.Mesh:
+    _require_init()
+    return _state.mesh
+
+
+def size() -> int:
+    """World size = product of the mesh's data axes (the analog of
+    reference byteps_size, operations.cc:84-86)."""
+    _require_init()
+    return _mesh_mod.world_size(_state.mesh)
+
+
+def rank() -> int:
+    """Worker id.  Multi-process: the process index (one worker per host,
+    SPMD); single-process: 0 — per-device "ranks" only exist inside
+    shard_map where ``lax.axis_index`` provides them."""
+    return jax.process_index()
+
+
+def local_rank() -> int:
+    return jax.process_index()
+
+
+def local_size() -> int:
+    """Devices handled by this process (reference byteps_local_size)."""
+    return jax.local_device_count()
+
+
+def declare(name: str) -> int:
+    """Reference byteps_torch_declare_tensor / ops.py:185-192."""
+    _require_init()
+    return _dispatcher.get_engine().declare(name)
+
+
+# ---------------------------------------------------------------------------
+# push_pull
+# ---------------------------------------------------------------------------
+
+_name_counter = [0]
+
+
+def _auto_name(prefix: str = "byteps_push_pull") -> str:
+    _name_counter[0] += 1
+    return f"{prefix}_{_name_counter[0]}"
+
+
+def push_pull(
+    tensor,
+    average: bool = True,
+    name: Optional[str] = None,
+    version: int = 0,
+    priority: int = 0,
+    compression: type = Compression.none,
+    axis_name: Optional[Any] = None,
+):
+    """Sum (or average) a tensor across workers.
+
+    Reference contract (torch/ops.py:96-141, mxnet tests): result equals the
+    elementwise sum over every worker's contribution, identically on all
+    workers.
+
+    Two calling modes:
+      * **inside shard_map / pjit** — pass ``axis_name`` (str or tuple); the
+        reduce runs as reduce-scatter + all-gather on that mesh axis.  This
+        is the hot path used by DistributedOptimizer's jitted step.
+      * **eager** — ``tensor`` is either one worker's contribution when
+        ``size()==1``, or contributions stacked on a leading worker axis
+        (shape ``[size(), ...]``).  Blocks until the result is ready.
+    """
+    if axis_name is not None:
+        compressed, ctx = compression.compress(tensor)
+        axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+        out = _collectives.push_pull_shard(
+            compressed.reshape(-1),
+            scatter_axis=axes[-1],
+            sum_axes=axes[:-1],
+            average=average,
+        ).reshape(tensor.shape)
+        return compression.decompress(out, ctx)
+    handle = push_pull_async(
+        tensor, average=average, name=name, version=version,
+        priority=priority, compression=compression,
+    )
+    return synchronize(handle)
+
+
+def push_pull_async(
+    tensor,
+    average: bool = True,
+    name: Optional[str] = None,
+    version: int = 0,
+    priority: int = 0,
+    compression: type = Compression.none,
+) -> int:
+    """Async eager push_pull; returns a handle (reference torch/ops.py:144-183)."""
+    _require_init()
+    engine = _dispatcher.get_engine()
+    n = size()
+    tensor = jnp.asarray(tensor)
+    if n == 1:
+        stacked = tensor[None]
+    elif tensor.shape and tensor.shape[0] == n:
+        stacked = tensor
+    else:
+        raise ValueError(
+            f"eager push_pull with size()=={n} expects contributions stacked "
+            f"on a leading worker axis of length {n}; got shape {tensor.shape}. "
+            "Inside a jitted step, pass axis_name= instead."
+        )
+    wire = getattr(compression, "wire_dtype", None)
+    return engine.push_pull_async(
+        stacked,
+        name or _auto_name(),
+        average=average,
+        priority=priority,
+        version=version,
+        wire_dtype=wire,
+    )
+
+
+def poll(handle: int) -> bool:
+    """Reference torch/ops.py:185-196 (poll)."""
+    _require_init()
+    return _dispatcher.get_engine().poll(handle)
+
+
+def synchronize(handle: int):
+    """Reference torch/ops.py:204-218 (synchronize)."""
+    _require_init()
+    return _dispatcher.get_engine().synchronize(handle)
+
+
+# ---------------------------------------------------------------------------
+# broadcast
+# ---------------------------------------------------------------------------
+
+
+def broadcast(
+    tensor,
+    root_rank: int = 0,
+    name: Optional[str] = None,
+    axis_name: Optional[Any] = None,
+):
+    """Every worker receives worker ``root_rank``'s value (reference
+    broadcast contract, tests/test_mxnet.py:116-158).  Same two calling
+    modes as push_pull; eager stacked input has shape ``[size(), ...]``."""
+    _require_init()
+    if axis_name is not None:
+        axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+        return _collectives.broadcast_shard(tensor, root_rank=root_rank, axes=axes)
+    n = size()
+    tensor = jnp.asarray(tensor)
+    if n == 1:
+        return tensor
+    if not tensor.shape or tensor.shape[0] != n:
+        raise ValueError(
+            f"eager broadcast expects stacked shape [{n}, ...]; got {tensor.shape}"
+        )
+    return _collectives.broadcast_stacked(
+        tensor, _state.mesh, _state.reduce_axes, root_rank=root_rank
+    )
+
+
+def broadcast_parameters(params, root_rank: int = 0):
+    """Consistent initialization: give every worker the root's parameters
+    (reference torch/__init__.py:234-262 — implemented there as
+    zero-non-root + push_pull(sum)).
+
+    Under single-controller JAX parameters are already one logical pytree;
+    "broadcast" means (a) across processes in a multi-host run — done with a
+    process-level broadcast from ``root_rank``'s host — and (b) placing every
+    leaf on the mesh fully replicated so each device holds the same bytes.
+    Returns the (possibly new) pytree — functional, no in-place mutation.
+    """
+    _require_init()
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        params = multihost_utils.broadcast_one_to_all(
+            params, is_source=jax.process_index() == root_rank
+        )
+    return jax.tree_util.tree_map(
+        lambda x: _collectives.replicate(jnp.asarray(x), _state.mesh), params
+    )
+
+
+def broadcast_optimizer_state(opt_state, root_rank: int = 0):
+    """Reference torch/__init__.py:265-381 — there it must tensor-ize scalar
+    optimizer state to broadcast it; optax state is already a pytree of
+    arrays, so the same replication path as parameters applies."""
+    return broadcast_parameters(opt_state, root_rank=root_rank)
+
+
+# Re-exported here so `bps.DistributedOptimizer` matches the reference name.
+from .training.optimizer import DistributedOptimizer  # noqa: E402  (circular-safe)

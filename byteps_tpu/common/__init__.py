@@ -1,0 +1,44 @@
+"""byteps_tpu.common — core runtime: types, config, registry, partitioner,
+scheduler.  Counterpart of reference ``byteps/common/`` (see SURVEY.md §2.1).
+"""
+
+from .config import Config, get_config, reset_config, set_config
+from .context import (
+    ServerSharder,
+    TensorContext,
+    TensorRegistry,
+    partition_key,
+    split_key,
+)
+from .partition import (
+    Bucket,
+    BucketPlan,
+    BucketSlice,
+    LeafSpec,
+    gather_buckets,
+    partition_offsets,
+    plan_buckets,
+    scatter_buckets,
+)
+from .ready_table import ReadyTable
+from .scheduler import ScheduledQueue
+from .types import (
+    DataType,
+    QueueType,
+    RequestType,
+    Status,
+    StatusType,
+    TensorTaskEntry,
+    get_command_type,
+)
+
+__all__ = [
+    "Config", "get_config", "set_config", "reset_config",
+    "TensorRegistry", "TensorContext", "ServerSharder",
+    "partition_key", "split_key",
+    "Bucket", "BucketPlan", "BucketSlice", "LeafSpec",
+    "plan_buckets", "gather_buckets", "scatter_buckets", "partition_offsets",
+    "ReadyTable", "ScheduledQueue",
+    "DataType", "Status", "StatusType", "QueueType", "RequestType",
+    "TensorTaskEntry", "get_command_type",
+]

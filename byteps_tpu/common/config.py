@@ -1,0 +1,139 @@
+"""Typed runtime configuration for byteps_tpu.
+
+The reference (BytePS) configures itself exclusively through environment
+variables in two namespaces: ``DMLC_*`` (the cluster contract) and
+``BYTEPS_*`` (behavior knobs) — see reference ``docs/env.md`` and the read
+sites in ``byteps/common/global.cc:39-119``.  We keep the same variable names
+where they still make sense on TPU, add a typed config object so code never
+re-parses the environment, and drop GPU-only knobs (NCCL ring counts, PCIe
+switch sizes) whose role is played by the XLA mesh layout here.
+
+TPU-native differences:
+  * one process per *host* (SPMD), not one per accelerator, so
+    ``BYTEPS_LOCAL_RANK`` defaults to ``jax.process_index()`` rather than a
+    launcher-injected value (reference ``launcher/launch.py:43-60``).
+  * partitioning is in *elements of the flat fp32 param space* internally,
+    but the env knob stays byte-denominated for compatibility
+    (``BYTEPS_PARTITION_BYTES``, default 4096000 — reference
+    ``byteps/common/global.cc:39``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    return int(v)
+
+
+def _env_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    return v.lower() not in ("0", "false", "no", "off", "")
+
+
+def _env_str(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+@dataclasses.dataclass
+class Config:
+    """Snapshot of all byteps_tpu knobs.
+
+    Mirrors the env contract of reference ``docs/env.md``; every field cites
+    the reference read-site it corresponds to.
+    """
+
+    # --- tensor partitioning (reference global.cc:39,96-103) -------------
+    partition_bytes: int = 4_096_000
+    # Reference aligns the partition bound to 8 * local_size bytes
+    # (global.cc:96-103); we align to 2 * lane-width elements so every
+    # partition reduce-scatters evenly over a mesh axis.
+    partition_align: int = 256
+
+    # --- scheduling (reference scheduled_queue.cc:24-42) -----------------
+    # credits = partition_bytes * (nccl_group_size + 1) in the reference;
+    # group_size default 4 (nccl_manager.cc:130-132). 0 => unlimited.
+    scheduling_credit: int = 0
+    group_size: int = 4
+
+    # --- cluster contract (reference communicator.cc:60-124, docs/env.md) -
+    num_worker: int = 1
+    worker_id: int = 0
+    local_rank: int = 0
+    local_size: int = 1
+    num_server: int = 1
+    force_distributed: bool = False
+
+    # --- modes -----------------------------------------------------------
+    enable_async: bool = False  # async PS mode (docs/env.md "Asynchronous")
+    use_hash_key: bool = False  # key->server sharding (global.cc:305-334)
+
+    # --- logging / debug (reference logging.cc:95-113, core_loops.cc:33) -
+    log_level: str = "WARNING"
+    debug_sample_tensor: str = ""
+    trace_path: str = ""  # chrome-trace output ("" = disabled)
+
+    # --- TPU-specific ----------------------------------------------------
+    wire_dtype: str = ""  # "" (no compression) | "bf16" | "fp16"
+    mesh_shape: str = ""  # e.g. "dp=8" or "dcn=2,dp=4"; "" = auto
+
+    @staticmethod
+    def from_env() -> "Config":
+        return Config(
+            partition_bytes=_env_int("BYTEPS_PARTITION_BYTES", 4_096_000),
+            scheduling_credit=_env_int("BYTEPS_SCHEDULING_CREDIT", 0),
+            group_size=_env_int("BYTEPS_NCCL_GROUP_SIZE", 4),
+            num_worker=_env_int("DMLC_NUM_WORKER", 1),
+            worker_id=_env_int("DMLC_WORKER_ID", 0),
+            local_rank=_env_int("BYTEPS_LOCAL_RANK", 0),
+            local_size=_env_int("BYTEPS_LOCAL_SIZE", 1),
+            num_server=_env_int("DMLC_NUM_SERVER", 1),
+            force_distributed=_env_bool("BYTEPS_FORCE_DISTRIBUTED"),
+            enable_async=_env_bool("BYTEPS_ENABLE_ASYNC"),
+            use_hash_key=_env_bool("BYTEPS_USE_HASH_KEY"),
+            log_level=_env_str("BYTEPS_LOG_LEVEL", "WARNING"),
+            debug_sample_tensor=_env_str("BYTEPS_DEBUG_SAMPLE_TENSOR", ""),
+            trace_path=_env_str("BYTEPS_TRACE_PATH", ""),
+            wire_dtype=_env_str("BYTEPS_WIRE_DTYPE", ""),
+            mesh_shape=_env_str("BYTEPS_MESH_SHAPE", ""),
+        )
+
+    @property
+    def effective_credit(self) -> int:
+        """Scheduling credit in bytes; reference scheduled_queue.cc:31-42.
+
+        0 in the env means "use the derived default"; the reference derives
+        ``partition_bytes * (group_size + 1)`` when scheduling is enabled
+        and effectively-unlimited (32 GB) otherwise.
+        """
+        if self.scheduling_credit > 0:
+            return self.scheduling_credit
+        return self.partition_bytes * (self.group_size + 1)
+
+
+_config: Optional[Config] = None
+
+
+def get_config() -> Config:
+    global _config
+    if _config is None:
+        _config = Config.from_env()
+    return _config
+
+
+def set_config(cfg: Config) -> None:
+    global _config
+    _config = cfg
+
+
+def reset_config() -> None:
+    global _config
+    _config = None

@@ -1,0 +1,44 @@
+"""Readiness barrier — counterpart of reference ``ready_table.{h,cc}``.
+
+A ``key -> count`` map with an expected count per key; a key becomes ready
+when its count reaches the expectation (reference ready_table.cc:17-41).  The
+reference keeps one instance per pipeline role (push/copy/pcie-reduce/
+nccl-reduce/broadcast, global.cc:147-167); under SPMD most of those barriers
+dissolve, but the eager engine still uses one to gate bucket dispatch on all
+of a bucket's constituent gradients having arrived.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+
+class ReadyTable:
+    def __init__(self, expected: int = 1, name: str = ""):
+        self._expected_default = expected
+        self._expected: Dict[int, int] = {}
+        self._count: Dict[int, int] = {}
+        self._lock = threading.Lock()
+        self.name = name
+
+    def set_expected(self, key: int, expected: int) -> None:
+        with self._lock:
+            self._expected[key] = expected
+
+    def add_ready_count(self, key: int, n: int = 1) -> int:
+        """Reference ready_table.cc:29-35."""
+        with self._lock:
+            self._count[key] = self._count.get(key, 0) + n
+            return self._count[key]
+
+    def is_key_ready(self, key: int) -> bool:
+        """Reference ready_table.cc:17-27."""
+        with self._lock:
+            expected = self._expected.get(key, self._expected_default)
+            return self._count.get(key, 0) >= expected
+
+    def clear_ready_count(self, key: int) -> None:
+        """Reference ready_table.cc:37-41."""
+        with self._lock:
+            self._count.pop(key, None)

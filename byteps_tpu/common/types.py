@@ -1,0 +1,186 @@
+"""Core value types: DataType, Status, QueueType.
+
+Counterpart of reference ``byteps/common/common.h``:
+  * ``DataType`` (common.h:39-52) — mshadow-ordered dtype enum; here each
+    member also carries its numpy/JAX dtype so adapters never switch on ints.
+  * ``Status``/``StatusType`` (common.h:57-108) — result type threaded
+    through handle-based async APIs.
+  * ``QueueType`` (common.h:68-80) — the 10 pipeline stages.  Under SPMD most
+    stages collapse (XLA's program-order collectives are self-synchronizing),
+    but we keep the enum for the eager engine's trace annotations and for the
+    scheduler's stage bookkeeping, so reference-style timelines read the same.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+
+class DataType(enum.IntEnum):
+    """Wire dtype enum; ordering follows reference common.h:39-52."""
+
+    FLOAT32 = 0
+    FLOAT64 = 1
+    FLOAT16 = 2
+    UINT8 = 3
+    INT32 = 4
+    INT8 = 5
+    INT64 = 6
+    # TPU-native addition: bfloat16 is the natural wire/compute dtype on TPU.
+    BFLOAT16 = 7
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return _NP_DTYPES[self]
+
+    @property
+    def itemsize(self) -> int:
+        if self is DataType.BFLOAT16:
+            return 2
+        return self.np_dtype.itemsize
+
+    @staticmethod
+    def from_dtype(dtype) -> "DataType":
+        name = np.dtype(dtype).name if str(dtype) != "bfloat16" else "bfloat16"
+        try:
+            return _FROM_NAME[str(name)]
+        except KeyError as e:
+            raise ValueError(f"unsupported dtype {dtype!r}") from e
+
+
+_NP_DTYPES = {
+    DataType.FLOAT32: np.dtype(np.float32),
+    DataType.FLOAT64: np.dtype(np.float64),
+    DataType.FLOAT16: np.dtype(np.float16),
+    DataType.UINT8: np.dtype(np.uint8),
+    DataType.INT32: np.dtype(np.int32),
+    DataType.INT8: np.dtype(np.int8),
+    DataType.INT64: np.dtype(np.int64),
+    DataType.BFLOAT16: np.dtype(np.float32),  # numpy has no bf16; host side up-casts
+}
+
+_FROM_NAME = {
+    "float32": DataType.FLOAT32,
+    "float64": DataType.FLOAT64,
+    "float16": DataType.FLOAT16,
+    "uint8": DataType.UINT8,
+    "int32": DataType.INT32,
+    "int8": DataType.INT8,
+    "int64": DataType.INT64,
+    "bfloat16": DataType.BFLOAT16,
+}
+
+
+class StatusType(enum.IntEnum):
+    """Reference common.h:57-66."""
+
+    OK = 0
+    UNKNOWN_ERROR = 1
+    PRECONDITION_ERROR = 2
+    ABORTED = 3
+    INVALID_ARGUMENT = 4
+    IN_PROGRESS = 5
+
+
+@dataclass(frozen=True)
+class Status:
+    """Reference common.h:57-108 — a tiny result type for the handle API."""
+
+    type: StatusType = StatusType.OK
+    reason: str = ""
+
+    @staticmethod
+    def OK() -> "Status":
+        return Status(StatusType.OK)
+
+    @staticmethod
+    def InProgress() -> "Status":
+        return Status(StatusType.IN_PROGRESS)
+
+    @staticmethod
+    def UnknownError(msg: str) -> "Status":
+        return Status(StatusType.UNKNOWN_ERROR, msg)
+
+    @staticmethod
+    def PreconditionError(msg: str) -> "Status":
+        return Status(StatusType.PRECONDITION_ERROR, msg)
+
+    @staticmethod
+    def Aborted(msg: str) -> "Status":
+        return Status(StatusType.ABORTED, msg)
+
+    @staticmethod
+    def InvalidArgument(msg: str) -> "Status":
+        return Status(StatusType.INVALID_ARGUMENT, msg)
+
+    def ok(self) -> bool:
+        return self.type == StatusType.OK
+
+    def in_progress(self) -> bool:
+        return self.type == StatusType.IN_PROGRESS
+
+
+class QueueType(enum.IntEnum):
+    """Pipeline stages; reference common.h:68-80.
+
+    On TPU the D2H/H2D copy stages and the unix-socket COORDINATE stages have
+    no physical counterpart (SPMD + HBM-resident buffers), but the eager
+    engine still tags tasks with the stage they are logically in so traces
+    and tests line up with the reference's timeline vocabulary.
+    """
+
+    COORDINATE_REDUCE = 0
+    REDUCE = 1
+    COPYD2H = 2
+    PCIE_REDUCE = 3
+    COORDINATE_PUSH = 4
+    PUSH = 5
+    PULL = 6
+    COPYH2D = 7
+    COORDINATE_BROADCAST = 8
+    BROADCAST = 9
+
+
+class RequestType(enum.IntEnum):
+    """Reference common.h:212-218."""
+
+    DEFAULT_PUSH_PULL = 0
+    ROW_SPARSE_PUSH_PULL = 1
+    COMPRESSED_PUSH_PULL = 2
+
+
+def get_command_type(request: RequestType, dtype: DataType) -> int:
+    """Cantor pairing of (request, dtype) — reference common.cc:98-101."""
+    x, y = int(request), int(dtype)
+    return (x + y) * (x + y + 1) // 2 + y
+
+
+@dataclass
+class TensorTaskEntry:
+    """The unit of scheduled work — counterpart of ``TensorTableEntry``
+    (reference common.h:170-209).
+
+    One declared tensor is split into >=1 partitions (reference
+    operations.cc:95-132); each partition is one TensorTaskEntry sharing the
+    parent's ``total_partitions`` countdown.  The eager engine moves entries
+    through ``queue_list`` stages; under jit the list is purely descriptive.
+    """
+
+    name: str
+    key: int
+    priority: int = 0
+    version: int = 0
+    offset: int = 0  # byte offset of this partition in the parent tensor
+    length: int = 0  # byte length of this partition
+    total_partitions: int = 1
+    partition_index: int = 0
+    queue_list: list = field(default_factory=list)
+    # engine-facing fields
+    payload: object = None  # jax.Array / np.ndarray chunk
+    output: object = None
+    callback: Optional[object] = None
+    counter_ref: Optional[list] = None  # shared [int] across partitions

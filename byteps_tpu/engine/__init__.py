@@ -1,0 +1,7 @@
+"""byteps_tpu.engine — eager-mode async push_pull engine (handles,
+priority dispatcher, completion pool)."""
+
+from .dispatcher import Engine, get_engine, start_engine, stop_engine
+from .handles import HandleManager
+
+__all__ = ["Engine", "HandleManager", "get_engine", "start_engine", "stop_engine"]

@@ -1,0 +1,250 @@
+"""The eager-mode communication engine.
+
+Counterpart of the reference's background machinery (SURVEY.md §1): the ~10
+``Run*LoopOnce`` threads draining ``BytePSScheduledQueue``s
+(core_loops.cc).  On TPU a single dispatcher thread suffices because JAX
+dispatch is already asynchronous: *launching* a collective costs
+microseconds and returns a future-like ``jax.Array``; the hardware queues do
+the pipelining that BytePS needed its thread-per-stage design for.
+
+What survives from the reference design, deliberately:
+  * tensors are partitioned into <=``BYTEPS_PARTITION_BYTES`` chunks, each an
+    independently scheduled task (operations.cc:95-132);
+  * the dispatcher grants tasks in (priority desc, key asc) order under a
+    byte-credit budget (scheduled_queue.cc:78-136) — credits bound how much
+    communication is in flight, which is exactly what
+    ``BYTEPS_SCHEDULING_CREDIT`` bounded;
+  * a completion pool returns credits and fires the per-tensor callback when
+    the last partition lands (FinishOrProceed, core_loops.cc:27-82).
+
+When the native C++ engine is built (byteps_tpu/native), the queue and
+handle table live in C++ and this module only hosts the JAX launch calls.
+"""
+
+from __future__ import annotations
+
+import queue as queue_mod
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common import logging as bps_log
+from ..common.config import get_config
+from ..common.context import TensorRegistry, partition_key
+from ..common.partition import partition_offsets
+from ..common.scheduler import ScheduledQueue
+from ..common.types import QueueType, Status, TensorTaskEntry
+from ..parallel import collectives
+from .handles import HandleManager
+
+
+class _PushPullRequest:
+    """Book-keeping for one user-level push_pull spanning >=1 partitions."""
+
+    def __init__(self, handle: int, name: str, num_parts: int, out_shape, out_dtype,
+                 postprocess: Optional[Callable] = None):
+        self.handle = handle
+        self.name = name
+        self.remaining = num_parts
+        self.chunks: List[Optional[jax.Array]] = [None] * num_parts
+        self.out_shape = out_shape
+        self.out_dtype = out_dtype
+        self.postprocess = postprocess
+        self.lock = threading.Lock()
+
+
+class Engine:
+    """One per process; owns the scheduler, dispatcher and completion pool."""
+
+    def __init__(self, mesh, reduce_axes: List[str]):
+        cfg = get_config()
+        self.mesh = mesh
+        self.reduce_axes = list(reduce_axes)
+        self.world = 1
+        for ax in self.reduce_axes:
+            self.world *= int(mesh.shape[ax])
+        self.registry = TensorRegistry()
+        self.handles = HandleManager()
+        self.queue = ScheduledQueue(
+            scheduled=True,
+            credit_bytes=cfg.effective_credit,
+            name="push_pull",
+        )
+        self._completion_q: "queue_mod.Queue" = queue_mod.Queue()
+        self._shutdown = threading.Event()
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="bps-dispatcher", daemon=True
+        )
+        self._completers = [
+            threading.Thread(target=self._completion_loop, name=f"bps-completer-{i}",
+                             daemon=True)
+            for i in range(2)
+        ]
+        self._dispatcher.start()
+        for t in self._completers:
+            t.start()
+
+    # ------------------------------------------------------------------ API
+
+    def declare(self, name: str) -> int:
+        return self.registry.declare(name).declared_key
+
+    def push_pull_async(
+        self,
+        stacked: jax.Array,
+        name: str,
+        average: bool = True,
+        priority: int = 0,
+        version: int = 0,
+        wire_dtype=None,
+        postprocess: Optional[Callable] = None,
+    ) -> int:
+        """Enqueue an allreduce of stacked per-worker contributions.
+
+        ``stacked`` has shape [world, ...] — worker w's tensor at index w
+        (single-controller rendering of per-rank push_pull; see
+        parallel/collectives.py).  Returns a handle for poll/synchronize.
+        """
+        cfg = get_config()
+        ctx = self.registry.declare(name)
+        if priority == 0:
+            priority = -ctx.declared_key  # reference tensorflow/ops.cc:158
+        out_shape = stacked.shape[1:]
+        out_dtype = stacked.dtype
+        flat = stacked.reshape(self.world, -1)
+        nbytes_per_worker = flat.shape[1] * flat.dtype.itemsize
+        parts = partition_offsets(nbytes_per_worker, cfg.partition_bytes)
+        itemsize = flat.dtype.itemsize
+
+        handle = self.handles.allocate()
+        req = _PushPullRequest(handle, name, len(parts), out_shape, out_dtype,
+                               postprocess)
+        counter = [len(parts)]
+        for i, (off_b, len_b) in enumerate(parts):
+            off_e, len_e = off_b // itemsize, len_b // itemsize
+            payload = jax.lax.slice_in_dim(flat, off_e, off_e + len_e, axis=1) \
+                if len(parts) > 1 else flat
+            task = TensorTaskEntry(
+                name=f"{name}_{i}" if len(parts) > 1 else name,
+                key=partition_key(ctx.declared_key, i),
+                priority=priority,
+                version=version,
+                offset=off_b,
+                length=max(1, len_b),
+                total_partitions=len(parts),
+                partition_index=i,
+                queue_list=[QueueType.REDUCE, QueueType.PUSH, QueueType.PULL,
+                            QueueType.BROADCAST],
+                payload=payload,
+                counter_ref=counter,
+            )
+            task.request = req  # type: ignore[attr-defined]
+            task.average = average  # type: ignore[attr-defined]
+            task.wire_dtype = wire_dtype  # type: ignore[attr-defined]
+            self.queue.add_task(task)
+        return handle
+
+    def poll(self, handle: int) -> bool:
+        return self.handles.poll(handle)
+
+    def synchronize(self, handle: int, timeout: Optional[float] = 120.0):
+        return self.handles.wait_and_clear(handle, timeout)
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        # wake the dispatcher with a poison add
+        self.queue.add_task(TensorTaskEntry(name="__poison__", key=-1, length=0))
+        self._completion_q.put(None)
+        self._dispatcher.join(timeout=5.0)
+        for t in self._completers:
+            t.join(timeout=5.0)
+
+    # -------------------------------------------------------------- threads
+
+    def _dispatch_loop(self) -> None:
+        """Grant tasks in priority/credit order and launch their collectives
+        (the analog of RunRootNcclLoopOnce + RunPushLoopOnce, but a launch is
+        just an async XLA dispatch)."""
+        while not self._shutdown.is_set():
+            task = self.queue.wait_task(timeout=0.25)
+            if task is None:
+                continue
+            if task.name == "__poison__":
+                break
+            try:
+                result = self._launch(task)
+                task.output = result
+                self._completion_q.put(task)
+            except Exception as e:  # pragma: no cover
+                bps_log.error("dispatch failed for %s: %s", task.name, e)
+                req: _PushPullRequest = task.request  # type: ignore[attr-defined]
+                self.handles.mark_done(req.handle, Status.UnknownError(str(e)))
+                self.queue.report_finish(task)
+
+    def _launch(self, task: TensorTaskEntry) -> jax.Array:
+        if self.world == 1:
+            return task.payload[0]
+        return collectives.push_pull_stacked(
+            task.payload,
+            self.mesh,
+            self.reduce_axes,
+            average=getattr(task, "average", False),
+            wire_dtype=getattr(task, "wire_dtype", None),
+        )
+
+    def _completion_loop(self) -> None:
+        """Block on launched collectives, return credits, assemble outputs,
+        fire callbacks (FinishOrProceed, core_loops.cc:27-82)."""
+        while True:
+            task = self._completion_q.get()
+            if task is None:
+                self._completion_q.put(None)  # let sibling completers exit
+                return
+            try:
+                jax.block_until_ready(task.output)
+                status = Status.OK()
+            except Exception as e:  # pragma: no cover
+                status = Status.UnknownError(str(e))
+            self.queue.report_finish(task)
+            req: _PushPullRequest = task.request  # type: ignore[attr-defined]
+            with req.lock:
+                req.chunks[task.partition_index] = task.output
+                req.remaining -= 1
+                done = req.remaining == 0
+            if done:
+                if not status.ok():
+                    self.handles.mark_done(req.handle, status)
+                    continue
+                chunks = [c for c in req.chunks if c is not None]
+                out = chunks[0] if len(chunks) == 1 else jnp.concatenate(chunks)
+                out = out.reshape(req.out_shape).astype(req.out_dtype)
+                if req.postprocess is not None:
+                    out = req.postprocess(out)
+                self.handles.mark_done(req.handle, Status.OK(), out)
+
+
+_engine: Optional[Engine] = None
+_engine_lock = threading.Lock()
+
+
+def get_engine() -> Optional[Engine]:
+    return _engine
+
+
+def start_engine(mesh, reduce_axes) -> Engine:
+    global _engine
+    with _engine_lock:
+        if _engine is None:
+            _engine = Engine(mesh, reduce_axes)
+        return _engine
+
+
+def stop_engine() -> None:
+    global _engine
+    with _engine_lock:
+        if _engine is not None:
+            _engine.shutdown()
+            _engine = None

@@ -1,0 +1,7 @@
+"""byteps_tpu.ops — compression and Pallas kernels for the hot paths."""
+
+from .compression import BF16Compressor, Compression, Compressor, FP16Compressor, NoneCompressor
+
+__all__ = [
+    "Compression", "Compressor", "NoneCompressor", "FP16Compressor", "BF16Compressor",
+]

@@ -1,0 +1,86 @@
+"""Gradient wire compression — counterpart of reference
+``byteps/torch/compression.py`` / ``tensorflow/compression.py`` (identical
+75-line files): a pluggable ``Compressor`` with ``compress``/``decompress``
+and a ``Compression`` namespace exposing ``none`` and ``fp16``.
+
+TPU addition: ``bf16`` — bfloat16 shares float32's exponent range, so it is
+the safe default wire format on TPU (no overflow scaling needed, and the
+VPU/ICI move it natively).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class Compressor:
+    """Interface for compressing and decompressing a given tensor
+    (reference compression.py:21-34)."""
+
+    wire_dtype = None  # dtype hint for the fused collective path
+
+    @staticmethod
+    def compress(tensor):
+        """Returns (compressed_tensor, context) needed to decompress it."""
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    """Default no-op (reference compression.py:37-47)."""
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor(Compressor):
+    """Cast to fp16 on the wire, restore dtype after
+    (reference compression.py:50-66)."""
+
+    wire_dtype = jnp.float16
+
+    @staticmethod
+    def compress(tensor):
+        dtype = tensor.dtype
+        if jnp.issubdtype(dtype, jnp.floating):
+            return tensor.astype(jnp.float16), dtype
+        return tensor, dtype
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor.astype(ctx) if ctx is not None else tensor
+
+
+class BF16Compressor(Compressor):
+    """bfloat16 wire format — the TPU-native compression choice."""
+
+    wire_dtype = jnp.bfloat16
+
+    @staticmethod
+    def compress(tensor):
+        dtype = tensor.dtype
+        if jnp.issubdtype(dtype, jnp.floating):
+            return tensor.astype(jnp.bfloat16), dtype
+        return tensor, dtype
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor.astype(ctx) if ctx is not None else tensor
+
+
+class Compression:
+    """Optional gradient compression algorithm used during push_pull
+    (reference compression.py:69-75)."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

@@ -1,0 +1,234 @@
+"""push_pull / broadcast collectives — the TPU-native communication core.
+
+This replaces the reference's entire data path (SURVEY.md §1 control flow:
+NCCL reduce-scatter -> D2H -> cross-PCIe CPU reduce -> ps-lite push -> server
+sum -> pull -> H2D -> NCCL allgather, core_loops.cc) with XLA collectives on
+a device mesh:
+
+  * intra-slice (ICI) reduce-scatter  == the NCCL ReduceScatter stage
+    (core_loops.cc:170-191);
+  * cross-slice (DCN axis) psum on the scattered shard == the push/server-
+    sum/pull stages (core_loops.cc:430-502) — each device only moves its
+    1/|dp| shard across DCN, exactly the bandwidth optimality argument of
+    BytePS's hierarchical design (docs/rationale.md);
+  * intra-slice all-gather == the NCCL AllGather/broadcast return stage
+    (core_loops.cc:192-206).
+
+No D2H/H2D copies (buffers live in HBM), no unix-socket coordination (SPMD
+programs are self-synchronizing), no CPU reducer (the scattered-shard psum
+rides DCN directly).  Priority scheduling survives as the *issue order* of
+per-bucket collectives inside the traced program (BucketPlan.schedule_order).
+
+All ``*_shard`` functions must be called inside ``shard_map`` (they use named
+axes); the ``push_pull_tree`` entry point is the one the training step uses.
+Eager, handle-based wrappers live in byteps_tpu.engine.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+try:  # jax >= 0.6
+    from jax import shard_map as _shard_map_mod
+
+    def shard_map(f, mesh, in_specs, out_specs, check_vma=False):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma
+        )
+except Exception:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs, check_vma=False):
+        return _legacy_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+        )
+
+from ..common import partition as partition_mod
+from ..common.partition import BucketPlan
+
+
+def _axis_size(axes) -> int:
+    """Static size of named axis/axes inside shard_map."""
+    return lax.psum(1, axes)
+
+
+def _pad_to(x: jax.Array, multiple: int) -> Tuple[jax.Array, int]:
+    n = x.shape[0]
+    rem = (-n) % multiple
+    if rem:
+        x = jnp.concatenate([x, jnp.zeros((rem,), x.dtype)])
+    return x, n
+
+
+def push_pull_shard(
+    x: jax.Array,
+    scatter_axis: Optional[str] = "dp",
+    sum_axes: Sequence[str] = (),
+    average: bool = False,
+    wire_dtype=None,
+) -> jax.Array:
+    """Allreduce one flat (1-D) buffer across mesh axes.  Call inside
+    shard_map where ``x`` is replicated over the reduce axes.
+
+    Hierarchy: reduce-scatter over ``scatter_axis`` (ICI), psum the shard
+    over ``sum_axes`` (DCN), all-gather back over ``scatter_axis`` — the
+    reference's 3-level reduction (SURVEY.md §2.4) in three XLA ops.
+
+    ``wire_dtype`` casts the payload before communication (the fp16/bf16
+    compression hook of reference torch/compression.py:21-75; bf16 is the
+    natural TPU wire format).
+    """
+    orig_dtype = x.dtype
+    if x.ndim != 1:
+        x = x.reshape(-1)
+    if wire_dtype is not None and x.dtype != wire_dtype:
+        x = x.astype(wire_dtype)
+
+    denom = 1
+    if average:
+        axes = (tuple(sum_axes) + ((scatter_axis,) if scatter_axis else ()))
+        denom = _axis_size(axes) if axes else 1
+
+    if scatter_axis is not None:
+        nshards = _axis_size(scatter_axis)
+        x, n = _pad_to(x, nshards)
+        y = lax.psum_scatter(x, scatter_axis, scatter_dimension=0, tiled=True)
+        if sum_axes:
+            y = lax.psum(y, tuple(sum_axes))
+        y = lax.all_gather(y, scatter_axis, axis=0, tiled=True)
+        y = y[:n]
+    else:
+        y = lax.psum(x, tuple(sum_axes)) if sum_axes else x
+
+    if average:
+        y = y / denom
+    return y.astype(orig_dtype)
+
+
+def broadcast_shard(
+    x: jax.Array,
+    root_rank: int = 0,
+    axes: Sequence[str] = ("dp",),
+) -> jax.Array:
+    """Broadcast ``root_rank``'s value to all members of ``axes``.
+
+    Uses the reference's own trick — zero on non-root, then sum
+    (tensorflow/ops.py:117,130-139) — which XLA lowers to an efficient
+    collective without a dedicated broadcast primitive.
+    """
+    axes = tuple(axes)
+    # linearized rank over the broadcast axes
+    idx = 0
+    for ax in axes:
+        idx = idx * _axis_size(ax) + lax.axis_index(ax)
+    mask = (idx == root_rank).astype(x.dtype)
+    return lax.psum(x * mask, axes)
+
+
+def push_pull_tree(
+    grads,
+    plan: Optional[BucketPlan] = None,
+    scatter_axis: Optional[str] = "dp",
+    sum_axes: Sequence[str] = (),
+    average: bool = True,
+    wire_dtype=None,
+    partition_bytes: int = 4_096_000,
+):
+    """Bucketed allreduce of a gradient pytree.  Call inside shard_map.
+
+    The pytree is packed into <=partition_bytes buckets (reference
+    PartitionTensor semantics + TPU fusion, common/partition.py) and one
+    collective is issued per bucket in priority order
+    (BucketPlan.schedule_order == scheduled_queue.cc ordering).  XLA's
+    latency-hiding scheduler overlaps the resulting async collective chain
+    with whatever compute neighbors the call.
+    """
+    if plan is None:
+        plan = partition_mod.plan_buckets(grads, partition_bytes)
+    buckets = partition_mod.gather_buckets(grads, plan)
+    reduced: List[Optional[jax.Array]] = [None] * len(buckets)
+    for i in plan.schedule_order():
+        reduced[i] = push_pull_shard(
+            buckets[i],
+            scatter_axis=scatter_axis,
+            sum_axes=sum_axes,
+            average=average,
+            wire_dtype=wire_dtype,
+        )
+    return partition_mod.scatter_buckets(reduced, plan)
+
+
+# ---------------------------------------------------------------------------
+# Eager (outside-jit) entry points: one controller, workers == mesh devices.
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _stacked_push_pull_fn(mesh: Mesh, axes: Tuple[str, ...], average: bool, wire: Optional[str]):
+    wire_dtype = jnp.dtype(wire) if wire else None
+    inner = axes[-1]
+    outer = axes[:-1]
+
+    def f(x):  # x: local slice [1, ...] of the stacked input
+        flat = x.reshape(-1)
+        y = push_pull_shard(
+            flat, scatter_axis=inner, sum_axes=outer,
+            average=average, wire_dtype=wire_dtype,
+        )
+        return y.reshape(x.shape[1:])
+
+    return jax.jit(
+        shard_map(f, mesh, in_specs=P(axes), out_specs=P())
+    )
+
+
+def push_pull_stacked(
+    x_stacked: jax.Array, mesh: Mesh, axes: Sequence[str], average: bool = False,
+    wire_dtype: Optional[str] = None,
+) -> jax.Array:
+    """Eager allreduce: ``x_stacked[w]`` is worker w's contribution
+    (w enumerates the mesh's reduce axes, row-major); returns the
+    sum/average, replicated.  This is the single-controller rendering of the
+    reference's per-rank push_pull (SURVEY.md §4 test contract: result ==
+    sum over ranks)."""
+    n = int(np.prod([mesh.shape[a] for a in axes]))
+    if x_stacked.shape[0] != n:
+        raise ValueError(
+            f"stacked push_pull expects leading axis == world size {n}, "
+            f"got shape {x_stacked.shape}"
+        )
+    fn = _stacked_push_pull_fn(mesh, tuple(axes), average, wire_dtype)
+    return fn(x_stacked)
+
+
+@functools.lru_cache(maxsize=None)
+def _stacked_broadcast_fn(mesh: Mesh, axes: Tuple[str, ...], root_rank: int):
+    def f(x):
+        return broadcast_shard(x.reshape(x.shape[1:]) if x.shape[0] == 1 else x[0],
+                               root_rank=root_rank, axes=axes)
+
+    return jax.jit(shard_map(f, mesh, in_specs=P(axes), out_specs=P()))
+
+
+def broadcast_stacked(
+    x_stacked: jax.Array, mesh: Mesh, axes: Sequence[str], root_rank: int = 0
+) -> jax.Array:
+    """Eager broadcast over stacked per-worker values: every worker receives
+    worker ``root_rank``'s slice (reference broadcast contract,
+    tests/test_mxnet.py:116-158)."""
+    fn = _stacked_broadcast_fn(mesh, tuple(axes), root_rank)
+    return fn(x_stacked)
+
+
+def replicate(x, mesh: Mesh):
+    """Place a host value on the mesh fully replicated."""
+    sharding = NamedSharding(mesh, P())
+    return jax.device_put(x, sharding)

@@ -1,0 +1,125 @@
+"""Device-mesh construction — the TPU-native replacement for the reference's
+topology logic.
+
+In BytePS topology handling is explicit plumbing: PCIe-switch grouping
+(``BYTEPS_PCIE_SWITCH_SIZE``, nccl_manager.cc:129-164), NUMA binding
+(global.cc:134-140) and NCCL ring construction (nccl_manager.cc:74-127).  On
+TPU all of that collapses into *choosing a mesh*: ICI-connected chips form
+the fast inner axes, DCN-connected slices the outer axis, and XLA lowers
+collectives onto the torus.  This module builds those meshes.
+
+Axis vocabulary (used across byteps_tpu):
+  * ``dcn``  — across slices / hosts over data-center network (the analog of
+               BytePS's ps-lite tier, SURVEY.md §2.4(c));
+  * ``dp``   — data parallel over ICI (the analog of the NCCL
+               reduce-scatter group);
+  * ``fsdp`` — parameter-sharded data parallel;
+  * ``tp``   — tensor (model) parallel;
+  * ``pp``   — pipeline parallel;
+  * ``sp``   — sequence/context parallel (ring attention);
+  * ``ep``   — expert parallel.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis order: outermost (slowest, DCN) to innermost (fastest, ICI).
+AXIS_ORDER = ("dcn", "pp", "dp", "fsdp", "ep", "sp", "tp")
+
+
+def parse_mesh_shape(spec: str) -> Dict[str, int]:
+    """Parse ``BYTEPS_MESH_SHAPE``-style strings, e.g. ``"dcn=2,dp=4"``."""
+    out: Dict[str, int] = {}
+    if not spec:
+        return out
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, val = part.partition("=")
+        name = name.strip()
+        if name not in AXIS_ORDER:
+            raise ValueError(f"unknown mesh axis {name!r}; valid: {AXIS_ORDER}")
+        out[name] = int(val)
+    return out
+
+
+def build_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    mesh_shape: Optional[Dict[str, int]] = None,
+    data_axis: str = "dp",
+) -> Mesh:
+    """Build the global mesh.
+
+    Defaults to pure data parallelism: a 1-D ``(dp,)`` mesh over all devices
+    in a single-slice run, or ``(dcn, dp)`` when multiple processes are
+    attached (jax.process_count() > 1), putting the process dimension on the
+    DCN axis so hierarchical reduction (ICI first, DCN second — the analog of
+    BytePS's local-reduce-then-push, SURVEY.md §2.4) falls out of axis order.
+
+    ``mesh_shape`` (or env ``BYTEPS_MESH_SHAPE``) overrides with arbitrary
+    named axes; axis sizes must multiply to the device count.  Unspecified
+    remainder goes to ``data_axis``.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+
+    shape = collections.OrderedDict()
+    if mesh_shape:
+        for ax in AXIS_ORDER:
+            if ax in mesh_shape:
+                shape[ax] = mesh_shape[ax]
+        given = int(np.prod(list(shape.values()))) if shape else 1
+        if n % given != 0:
+            raise ValueError(
+                f"mesh shape {dict(shape)} does not divide device count {n}"
+            )
+        if given != n:
+            if data_axis in shape:
+                raise ValueError(
+                    f"mesh shape {dict(shape)} covers {given} devices, have {n}"
+                )
+            shape[data_axis] = n // given
+            # keep canonical order
+            ordered = collections.OrderedDict()
+            for ax in AXIS_ORDER:
+                if ax in shape:
+                    ordered[ax] = shape[ax]
+            shape = ordered
+    else:
+        nproc = jax.process_count()
+        if nproc > 1 and n % nproc == 0 and n > nproc:
+            shape["dcn"] = nproc
+            shape[data_axis] = n // nproc
+        else:
+            shape[data_axis] = n
+
+    dims = list(shape.values())
+    names = tuple(shape.keys())
+    dev_array = np.asarray(devices).reshape(dims)
+    return Mesh(dev_array, axis_names=names)
+
+
+def reduce_axes(mesh: Mesh, data_axes: Sequence[str] = ("dcn", "dp", "fsdp")) -> List[str]:
+    """The mesh axes a gradient allreduce must span (present-in-mesh subset),
+    ordered outer->inner so hierarchical reduction can run inner-first."""
+    return [ax for ax in data_axes if ax in mesh.axis_names]
+
+
+def axis_size(mesh: Mesh, name: str) -> int:
+    return int(mesh.shape[name]) if name in mesh.axis_names else 1
+
+
+def world_size(mesh: Mesh, data_axes: Sequence[str] = ("dcn", "dp", "fsdp")) -> int:
+    s = 1
+    for ax in reduce_axes(mesh, data_axes):
+        s *= axis_size(mesh, ax)
+    return s

@@ -1,0 +1,6 @@
+"""byteps_tpu.training — DistributedOptimizer, trainer, async-PS mode,
+callbacks."""
+
+from .optimizer import DistributedOptimizer, push_pull_gradients
+
+__all__ = ["DistributedOptimizer", "push_pull_gradients"]

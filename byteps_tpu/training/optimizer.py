@@ -1,0 +1,109 @@
+"""DistributedOptimizer — the optax rendering of the reference's
+``byteps.torch.DistributedOptimizer`` (torch/__init__.py:98-231) and
+``DistributedTrainer`` (mxnet/__init__.py:142-204).
+
+The reference hooks the framework's autograd to push_pull each gradient as
+it materializes, then ``synchronize()``s before the optimizer step.  In JAX
+the whole step is one traced program, so the same behavior is expressed
+compositionally: a gradient transformation that allreduces (bucketed, in
+priority order) sits in front of the user's optimizer, and XLA overlaps the
+resulting collective chain with the backward compute the same way BytePS's
+background threads overlapped NCCL with autograd.
+
+``backward_passes_per_step`` (reference torch/__init__.py:107-154) is
+honored via optax.MultiSteps: gradients accumulate locally for k steps and
+only the k-th triggers communication — the same wire traffic reduction.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Sequence, Union
+
+import jax
+import optax
+
+from ..common.config import get_config
+from ..common.partition import BucketPlan, plan_buckets
+from ..ops.compression import Compression
+from ..parallel.collectives import push_pull_tree
+
+
+class PushPullState(NamedTuple):
+    """No dynamic state; the bucket plan is trace-time static."""
+
+
+def push_pull_gradients(
+    axis_name: Union[str, Sequence[str], None] = "dp",
+    average: bool = True,
+    compression: type = Compression.none,
+    partition_bytes: Optional[int] = None,
+    plan: Optional[BucketPlan] = None,
+) -> optax.GradientTransformation:
+    """An optax transformation that allreduces incoming gradients across the
+    data axes via the bucketed reduce-scatter/all-gather path.
+
+    Must run inside shard_map over a mesh containing ``axis_name`` (the
+    innermost/ICI axis is the last element when a sequence is given; leading
+    axes — e.g. ``"dcn"`` — are summed hierarchically on the scattered
+    shard, reference SURVEY.md §2.4 3-level reduction).
+    ``axis_name=None`` means single-worker: pass-through (the reference
+    likewise short-circuits when size()==1).
+    """
+    pb = partition_bytes or get_config().partition_bytes
+    wire = getattr(compression, "wire_dtype", None)
+
+    def init_fn(params):
+        del params
+        return PushPullState()
+
+    def update_fn(updates, state, params=None):
+        del params
+        if axis_name is None:
+            return updates, state
+        axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+        reduced = push_pull_tree(
+            updates,
+            plan=plan,
+            scatter_axis=axes[-1],
+            sum_axes=axes[:-1],
+            average=average,
+            wire_dtype=wire,
+            partition_bytes=pb,
+        )
+        return reduced, state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def DistributedOptimizer(
+    optimizer: optax.GradientTransformation,
+    named_parameters: Any = None,  # accepted for API parity; unused in JAX
+    compression: type = Compression.none,
+    backward_passes_per_step: int = 1,
+    axis_name: Union[str, Sequence[str], None] = "dp",
+    average: bool = True,
+    partition_bytes: Optional[int] = None,
+    plan: Optional[BucketPlan] = None,
+) -> optax.GradientTransformation:
+    """Wrap an optax optimizer so its gradients are push_pulled across
+    workers first (reference torch/__init__.py:383-402 factory).
+
+    Usage inside a shard_mapped train step::
+
+        opt = bps.DistributedOptimizer(optax.sgd(0.1), axis_name="dp")
+        updates, opt_state = opt.update(grads, opt_state, params)
+    """
+    del named_parameters
+    tx = optax.chain(
+        push_pull_gradients(
+            axis_name=axis_name,
+            average=average,
+            compression=compression,
+            partition_bytes=partition_bytes,
+            plan=plan,
+        ),
+        optimizer,
+    )
+    if backward_passes_per_step > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=backward_passes_per_step)
+    return tx

@@ -1,0 +1,44 @@
+"""Test harness: fake an 8-device mesh on CPU.
+
+The reference has no multi-node test harness at all (SURVEY.md §4) — its
+closest analog is ``BYTEPS_FORCE_DISTRIBUTED=1``.  We do what the survey
+prescribes: run every test on a virtual 8-device CPU platform so collective
+numerics and sharding are exercised without TPU hardware.
+
+Note: in this image ``sitecustomize`` pre-imports jax (axon PJRT plugin), so
+``JAX_PLATFORMS``/``XLA_FLAGS`` env edits here are too late — we must go
+through ``jax.config.update`` before any backend is initialized.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses we spawn
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_state():
+    """Each test gets a pristine byteps_tpu global state."""
+    yield
+    try:
+        import byteps_tpu
+
+        byteps_tpu.shutdown()
+    except Exception:
+        pass
+
+
+@pytest.fixture
+def devices():
+    return jax.devices()

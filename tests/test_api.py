@@ -1,0 +1,130 @@
+"""Horovod-compatible API tests — the behavioral contracts encoded by
+reference tests/test_mxnet.py (push_pull sums / broadcast semantics) and the
+handle-based async API of torch/ops.py."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import byteps_tpu as bps
+from byteps_tpu.parallel import build_mesh
+
+
+@pytest.fixture
+def init8():
+    bps.init(mesh=build_mesh(mesh_shape={"dp": 8}))
+    yield
+    bps.shutdown()
+
+
+class TestLifecycle:
+    def test_init_idempotent(self, init8):
+        bps.init()
+        assert bps.size() == 8
+
+    def test_rank_local(self, init8):
+        assert bps.rank() == 0
+        assert bps.local_size() == 8
+
+    def test_declare_monotonic(self, init8):
+        k0 = bps.declare("Gradient.g0")
+        k1 = bps.declare("Gradient.g1")
+        assert (k0, k1) == (0, 1)
+        assert bps.declare("Gradient.g0") == 0
+
+
+class TestPushPull:
+    def test_sum_contract(self, init8):
+        # reference test_mxnet.py:76-113: result == sum over every rank's tensor
+        rng = np.random.RandomState(0)
+        x = rng.randn(8, 50).astype(np.float32)
+        out = bps.push_pull(jnp.asarray(x), average=False, name="t0")
+        np.testing.assert_allclose(np.asarray(out), x.sum(0), rtol=1e-5)
+
+    def test_average(self, init8):
+        x = np.ones((8, 4), np.float32) * np.arange(8)[:, None]
+        out = bps.push_pull(jnp.asarray(x), average=True, name="t1")
+        np.testing.assert_allclose(np.asarray(out), np.full((4,), 3.5), rtol=1e-6)
+
+    def test_async_poll_synchronize(self, init8):
+        x = jnp.ones((8, 1000), jnp.float32)
+        h = bps.push_pull_async(x, average=False, name="t2")
+        import time
+        deadline = time.time() + 30
+        while not bps.poll(h):
+            assert time.time() < deadline, "push_pull never completed"
+            time.sleep(0.001)
+        out = bps.synchronize(h)
+        np.testing.assert_allclose(np.asarray(out), np.full((1000,), 8.0))
+        # handle is cleared after synchronize (reference WaitAndClear)
+        with pytest.raises(ValueError):
+            bps.poll(h)
+
+    def test_many_tensors_interleaved(self, init8):
+        handles = {}
+        for i in range(10):
+            x = jnp.full((8, 64), float(i))
+            handles[i] = bps.push_pull_async(x, average=False, name=f"g{i}")
+        for i, h in handles.items():
+            out = bps.synchronize(h)
+            np.testing.assert_allclose(np.asarray(out), np.full((64,), 8.0 * i))
+
+    def test_partitioned_large_tensor(self, init8):
+        # Force multi-partition: tensor bigger than partition bound.
+        from byteps_tpu.common.config import get_config, set_config
+        cfg = get_config()
+        import dataclasses
+        set_config(dataclasses.replace(cfg, partition_bytes=1024))
+        try:
+            rng = np.random.RandomState(1)
+            x = rng.randn(8, 2000).astype(np.float32)  # 8000 B/worker -> 8 parts
+            out = bps.push_pull(jnp.asarray(x), average=False, name="big")
+            np.testing.assert_allclose(np.asarray(out), x.sum(0), rtol=1e-4)
+        finally:
+            set_config(dataclasses.replace(cfg, partition_bytes=4_096_000))
+
+    def test_shape_error(self, init8):
+        with pytest.raises(ValueError):
+            bps.push_pull(jnp.ones((3, 3)), name="bad")
+
+    def test_compression_fp16(self, init8):
+        x = np.full((8, 32), 0.5, np.float32)
+        out = bps.push_pull(jnp.asarray(x), average=False, name="c",
+                            compression=bps.Compression.fp16)
+        assert out.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(out), np.full((32,), 4.0), rtol=1e-2)
+
+
+class TestBroadcast:
+    def test_broadcast_root(self, init8):
+        # reference test_mxnet.py:116-158: non-root receives root's tensor
+        x = np.stack([np.full((6,), r, np.float32) for r in range(8)])
+        for root in (0, 3, 7):
+            out = bps.broadcast(jnp.asarray(x), root_rank=root, name=f"b{root}")
+            np.testing.assert_array_equal(np.asarray(out), np.full((6,), float(root)))
+
+    def test_broadcast_parameters(self, init8):
+        params = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+        out = bps.broadcast_parameters(params, root_rank=0)
+        np.testing.assert_array_equal(np.asarray(out["w"]), np.ones((4, 4)))
+        # replicated across all devices
+        assert out["w"].sharding.is_fully_replicated
+
+    def test_broadcast_optimizer_state(self, init8):
+        import optax
+        opt = optax.adam(1e-3)
+        st = opt.init({"w": jnp.ones((3,))})
+        out = bps.broadcast_optimizer_state(st, root_rank=0)
+        leaves = jax.tree_util.tree_leaves(out)
+        assert all(l.sharding.is_fully_replicated for l in leaves if hasattr(l, "sharding"))
+
+
+class TestSingleWorker:
+    def test_size_one_identity(self):
+        bps.init(mesh=build_mesh(devices=jax.devices()[:1]))
+        assert bps.size() == 1
+        x = jnp.arange(10, dtype=jnp.float32)
+        out = bps.push_pull(x, average=True, name="solo")
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+        bps.shutdown()

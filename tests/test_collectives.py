@@ -1,0 +1,118 @@
+"""Collective numerics on the 8-device CPU mesh — the behavioral contracts of
+reference tests/test_mxnet.py:76-158 (push_pull sums, broadcast delivers the
+root's tensor) plus the bucketed tree path."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from byteps_tpu.common.partition import plan_buckets
+from byteps_tpu.parallel import (
+    broadcast_shard,
+    broadcast_stacked,
+    build_mesh,
+    push_pull_shard,
+    push_pull_stacked,
+    push_pull_tree,
+    shard_map,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return build_mesh(mesh_shape={"dp": 8})
+
+
+@pytest.fixture(scope="module")
+def mesh2d():
+    return build_mesh(mesh_shape={"dcn": 2, "dp": 4})
+
+
+def test_push_pull_stacked_sum(mesh):
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 33).astype(np.float32)
+    out = push_pull_stacked(jnp.asarray(x), mesh, ("dp",), average=False)
+    np.testing.assert_allclose(np.asarray(out), x.sum(0), rtol=1e-5)
+
+
+def test_push_pull_stacked_average(mesh):
+    x = np.arange(8 * 10, dtype=np.float32).reshape(8, 10)
+    out = push_pull_stacked(jnp.asarray(x), mesh, ("dp",), average=True)
+    np.testing.assert_allclose(np.asarray(out), x.mean(0), rtol=1e-5)
+
+
+def test_push_pull_odd_sizes_padding(mesh):
+    # 13 elements does not divide 8 — exercises the pad/unpad path.
+    x = np.random.RandomState(1).randn(8, 13).astype(np.float32)
+    out = push_pull_stacked(jnp.asarray(x), mesh, ("dp",), average=False)
+    np.testing.assert_allclose(np.asarray(out), x.sum(0), rtol=1e-5)
+
+
+def test_push_pull_hierarchical_dcn(mesh2d):
+    # 3-level reduction analog: scatter over dp, sum over dcn, gather over dp.
+    x = np.random.RandomState(2).randn(8, 21).astype(np.float32)
+    out = push_pull_stacked(jnp.asarray(x), mesh2d, ("dcn", "dp"), average=False)
+    np.testing.assert_allclose(np.asarray(out), x.sum(0), rtol=1e-4)
+
+
+def test_push_pull_bf16_wire(mesh):
+    x = np.ones((8, 16), dtype=np.float32)
+    out = push_pull_stacked(jnp.asarray(x), mesh, ("dp",), average=False,
+                            wire_dtype="bfloat16")
+    assert out.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(out), 8 * np.ones(16), rtol=1e-2)
+
+
+def test_broadcast_stacked(mesh):
+    x = np.stack([np.full((5,), r, dtype=np.float32) for r in range(8)])
+    out = broadcast_stacked(jnp.asarray(x), mesh, ("dp",), root_rank=3)
+    np.testing.assert_array_equal(np.asarray(out), np.full((5,), 3.0))
+
+
+def test_broadcast_shard_inside_shard_map(mesh):
+    def f(x):
+        return broadcast_shard(x[0], root_rank=5, axes=("dp",))
+
+    fn = jax.jit(shard_map(f, mesh, in_specs=P("dp"), out_specs=P()))
+    x = jnp.arange(8, dtype=jnp.float32).reshape(8, 1)
+    out = fn(x)
+    np.testing.assert_array_equal(np.asarray(out), [5.0])
+
+
+def test_push_pull_tree_matches_dense_allreduce(mesh):
+    rng = np.random.RandomState(3)
+    tree = {
+        "w1": rng.randn(8, 17, 9).astype(np.float32),
+        "b1": rng.randn(8, 9).astype(np.float32),
+        "w2": rng.randn(8, 9, 3).astype(np.float32),
+    }
+    plan = plan_buckets(
+        {k: jax.ShapeDtypeStruct(v.shape[1:], v.dtype) for k, v in tree.items()},
+        partition_bytes=128,
+    )
+
+    def f(t):
+        local = {k: v[0] for k, v in t.items()}
+        return push_pull_tree(local, plan=plan, scatter_axis="dp", average=True)
+
+    fn = jax.jit(shard_map(
+        f, mesh,
+        in_specs=({k: P("dp") for k in tree},),
+        out_specs={k: P() for k in tree},
+    ))
+    out = fn({k: jnp.asarray(v) for k, v in tree.items()})
+    for k, v in tree.items():
+        np.testing.assert_allclose(np.asarray(out[k]), v.mean(0), rtol=1e-5)
+
+
+def test_push_pull_shard_int_dtype(mesh):
+    x = np.arange(8 * 6, dtype=np.int32).reshape(8, 6)
+
+    def f(xs):
+        return push_pull_shard(xs[0], scatter_axis="dp", average=False)
+
+    fn = jax.jit(shard_map(f, mesh, in_specs=P("dp"), out_specs=P()))
+    out = fn(jnp.asarray(x))
+    np.testing.assert_array_equal(np.asarray(out), x.sum(0))

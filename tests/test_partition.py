@@ -1,0 +1,115 @@
+"""Unit tests for the partitioner/bucketizer (reference operations.cc:95-132
+behavioral contract + TPU fusion-bucket extension)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from byteps_tpu.common import partition as P
+
+
+class TestPartitionOffsets:
+    def test_exact_multiple(self):
+        assert P.partition_offsets(100, 25) == [(0, 25), (25, 25), (50, 25), (75, 25)]
+
+    def test_remainder(self):
+        assert P.partition_offsets(10, 4) == [(0, 4), (4, 4), (8, 2)]
+
+    def test_single(self):
+        assert P.partition_offsets(3, 100) == [(0, 3)]
+
+    def test_zero(self):
+        assert P.partition_offsets(0, 4) == [(0, 0)]
+
+    def test_bad_bound(self):
+        with pytest.raises(ValueError):
+            P.partition_offsets(10, 0)
+
+
+def _tree():
+    return {
+        "layer0": {"w": jnp.zeros((8, 16), jnp.float32), "b": jnp.zeros((16,), jnp.float32)},
+        "layer1": {"w": jnp.zeros((16, 4), jnp.float32)},
+    }
+
+
+class TestBucketPlan:
+    def test_all_elements_covered_once(self):
+        plan = P.plan_buckets(_tree(), partition_bytes=200)
+        covered = {}
+        for b in plan.buckets:
+            for s in b.slices:
+                for e in range(s.leaf_start, s.leaf_start + s.length):
+                    key = (s.leaf_index, e)
+                    assert key not in covered, "element covered twice"
+                    covered[key] = True
+        total = sum(l.size for l in plan.leaves)
+        assert len(covered) == total
+
+    def test_bucket_size_bound(self):
+        plan = P.plan_buckets(_tree(), partition_bytes=100)
+        bound_elems = 100 // 4
+        for b in plan.buckets:
+            assert b.size <= bound_elems
+
+    def test_large_leaf_split(self):
+        tree = {"big": jnp.zeros((1000,), jnp.float32)}
+        plan = P.plan_buckets(tree, partition_bytes=1024)  # 256 elems/bucket
+        assert plan.num_buckets == 4
+        assert [b.size for b in plan.buckets] == [256, 256, 256, 232]
+
+    def test_small_leaves_fused(self):
+        tree = {f"p{i}": jnp.zeros((10,), jnp.float32) for i in range(10)}
+        plan = P.plan_buckets(tree, partition_bytes=4_096_000)
+        assert plan.num_buckets == 1
+        assert plan.buckets[0].size == 100
+
+    def test_priority_rule(self):
+        # priority = -min(leaf_index): earlier-declared params get higher
+        # priority (reference tensorflow/ops.cc:158).
+        plan = P.plan_buckets(_tree(), partition_bytes=64 * 4)
+        prios = {}
+        for b in plan.buckets:
+            prios[b.bucket_id] = b.priority
+        order = plan.schedule_order()
+        sorted_prios = [plan.buckets[i].priority for i in order]
+        assert sorted_prios == sorted(sorted_prios, reverse=True)
+
+    def test_roundtrip(self):
+        tree = {
+            "a": jnp.arange(37, dtype=jnp.float32).reshape(37),
+            "b": jnp.arange(24, dtype=jnp.float32).reshape(4, 6) * 2,
+            "c": jnp.arange(5, dtype=jnp.float32) - 3,
+        }
+        plan = P.plan_buckets(tree, partition_bytes=64)
+        arrs = P.gather_buckets(tree, plan)
+        out = P.scatter_buckets(arrs, plan)
+        for k in tree:
+            np.testing.assert_array_equal(np.asarray(tree[k]), np.asarray(out[k]))
+
+    def test_roundtrip_under_jit(self):
+        tree = {"w": jnp.arange(100, dtype=jnp.float32), "b": jnp.ones((7,), jnp.float32)}
+        plan = P.plan_buckets(tree, partition_bytes=128)
+
+        @jax.jit
+        def f(t):
+            return P.scatter_buckets([a * 2 for a in P.gather_buckets(t, plan)], plan)
+
+        out = f(tree)
+        np.testing.assert_array_equal(np.asarray(out["w"]), np.arange(100) * 2.0)
+
+    def test_mixed_dtypes_not_fused(self):
+        tree = {"f": jnp.zeros((10,), jnp.float32), "i": jnp.zeros((10,), jnp.int32),
+                "h": jnp.zeros((10,), jnp.bfloat16)}
+        plan = P.plan_buckets(tree, partition_bytes=4_096_000)
+        for b in plan.buckets:
+            dts = {plan.leaves[s.leaf_index].dtype for s in b.slices}
+            assert len(dts) == 1
+
+    def test_reverse_packing_order(self):
+        # last leaf should land in the first bucket (backward-pass overlap).
+        tree = {"a": jnp.zeros((10,)), "z": jnp.zeros((10,))}
+        plan = P.plan_buckets(tree, partition_bytes=10 * 4)
+        first_bucket_leaves = {s.leaf_index for s in plan.buckets[0].slices}
+        assert first_bucket_leaves == {len(plan.leaves) - 1}

@@ -1,0 +1,154 @@
+"""Unit tests for ScheduledQueue / ReadyTable / registry / sharder —
+behavioral contracts of reference scheduled_queue.cc, ready_table.cc,
+global.cc:290-334."""
+
+import threading
+
+import pytest
+
+from byteps_tpu.common import (
+    ReadyTable,
+    ScheduledQueue,
+    ServerSharder,
+    TensorRegistry,
+    TensorTaskEntry,
+    partition_key,
+    split_key,
+)
+
+
+def task(name, key, priority=0, length=100):
+    return TensorTaskEntry(name=name, key=key, priority=priority, length=length)
+
+
+class TestScheduledQueue:
+    def test_priority_order(self):
+        q = ScheduledQueue()
+        q.add_task(task("low", 1, priority=-5))
+        q.add_task(task("high", 2, priority=0))
+        q.add_task(task("mid", 3, priority=-2))
+        assert q.get_task().name == "high"
+        assert q.get_task().name == "mid"
+        assert q.get_task().name == "low"
+
+    def test_key_tiebreak(self):
+        q = ScheduledQueue()
+        q.add_task(task("b", 7, priority=0))
+        q.add_task(task("a", 3, priority=0))
+        assert q.get_task().key == 3
+        assert q.get_task().key == 7
+
+    def test_credit_gate(self):
+        # reference scheduled_queue.cc:100-136: task bigger than remaining
+        # credits is skipped; finishing returns credits.
+        q = ScheduledQueue(scheduled=True, credit_bytes=100)
+        big = task("big", 1, priority=0, length=80)
+        big2 = task("big2", 2, priority=0, length=80)
+        q.add_task(big)
+        q.add_task(big2)
+        got = q.get_task()
+        assert got.name == "big"
+        assert q.get_task() is None  # only 20 credits left
+        q.report_finish(got)
+        assert q.get_task().name == "big2"
+
+    def test_ready_gate(self):
+        ready = {1: False, 2: True}
+        q = ScheduledQueue(ready_check=lambda t: ready[t.key])
+        q.add_task(task("not_ready", 1, priority=10))
+        q.add_task(task("ready", 2, priority=0))
+        # higher-priority task is skipped because not ready
+        assert q.get_task().name == "ready"
+        ready[1] = True
+        assert q.get_task().name == "not_ready"
+
+    def test_get_by_key(self):
+        q = ScheduledQueue()
+        q.add_task(task("x", 11))
+        q.add_task(task("y", 22))
+        assert q.get_task(key=22).name == "y"
+        assert q.get_task(key=22) is None
+
+    def test_wait_task_blocks_until_add(self):
+        q = ScheduledQueue()
+        out = []
+
+        def consumer():
+            out.append(q.wait_task(timeout=5.0))
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        q.add_task(task("later", 1))
+        t.join(timeout=5.0)
+        assert out and out[0].name == "later"
+
+
+class TestReadyTable:
+    def test_counts(self):
+        rt = ReadyTable(expected=3)
+        assert not rt.is_key_ready(5)
+        rt.add_ready_count(5)
+        rt.add_ready_count(5)
+        assert not rt.is_key_ready(5)
+        rt.add_ready_count(5)
+        assert rt.is_key_ready(5)
+        rt.clear_ready_count(5)
+        assert not rt.is_key_ready(5)
+
+    def test_per_key_expected(self):
+        rt = ReadyTable(expected=1)
+        rt.set_expected(9, 2)
+        rt.add_ready_count(9)
+        assert not rt.is_key_ready(9)
+        rt.add_ready_count(9)
+        assert rt.is_key_ready(9)
+
+
+class TestRegistry:
+    def test_monotonic_keys_and_idempotence(self):
+        r = TensorRegistry()
+        a = r.declare("Gradient.a")
+        b = r.declare("Gradient.b")
+        a2 = r.declare("Gradient.a")
+        assert a.declared_key == 0 and b.declared_key == 1
+        assert a2 is a
+
+    def test_get_missing_raises(self):
+        r = TensorRegistry()
+        with pytest.raises(KeyError):
+            r.get("nope")
+
+
+class TestKeys:
+    def test_partition_key_layout(self):
+        # reference operations.cc:214-230: declared_key<<16 | part
+        k = partition_key(5, 3)
+        assert k == (5 << 16) | 3
+        assert split_key(k) == (5, 3)
+
+    def test_partition_key_range(self):
+        with pytest.raises(ValueError):
+            partition_key(1, 1 << 16)
+
+
+class TestServerSharder:
+    def test_placement_formula(self):
+        # bit-compatible with reference global.cc:305-334
+        s = ServerSharder(num_shards=4)
+        key = partition_key(7, 2)
+        expected = (((key >> 16) + key % 65536) * 9973) % 4
+        assert s.place(key) == expected
+
+    def test_load_accounting(self):
+        s = ServerSharder(num_shards=2)
+        s.place(partition_key(0, 0), nbytes=100)
+        s.place(partition_key(0, 1), nbytes=50)
+        assert sum(s.load()) == 150
+
+    def test_reasonable_balance(self):
+        s = ServerSharder(num_shards=4)
+        counts = [0] * 4
+        for dk in range(64):
+            for p in range(4):
+                counts[s.place(partition_key(dk, p))] += 1
+        assert min(counts) > 0
