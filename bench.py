@@ -1,0 +1,146 @@
+"""Benchmark: ResNet50 fp32, batch 64/chip — the reference's headline config
+(SURVEY.md §6: "ResNet50 fp32 (batch 64/GPU) images/sec"; BASELINE.json
+configs[1]).
+
+Measures images/sec of the framework's full data-parallel train step
+(scheduled bucketed push_pull + BatchNorm state + SGD-momentum) on the
+available chip(s), and compares against a plain hand-written jax step on the
+same model — the "Horovod analog" of SURVEY.md §7 (no scheduling layer).
+``vs_baseline`` = framework / plain: >= 1.0 means the scheduling layer costs
+nothing (single chip) or wins (multi chip, comm overlap).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": R}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh
+
+from byteps_tpu.models import ResNet50
+from byteps_tpu.training import (
+    classification_loss_fn,
+    make_data_parallel_step,
+    shard_batch,
+)
+
+WARMUP = 5
+ITERS = 30
+
+
+def _time_steps(fn, state, batch, iters):
+    # warmup (includes compile)
+    for _ in range(WARMUP):
+        state, metrics = fn(state, batch)
+    jax.block_until_ready((state, metrics))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = fn(state, batch)
+    # block on the FULL output state: on this tunneled TPU, blocking on a
+    # small output alone under-reports (async dispatch returns early)
+    jax.block_until_ready((state, metrics))
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    on_tpu = jax.devices()[0].platform == "tpu"
+    n_dev = len(jax.devices())
+    if on_tpu:
+        batch_per_chip, hw, classes, filters = 64, 224, 1000, 64
+    else:  # CPU smoke mode so the script stays runnable anywhere
+        batch_per_chip, hw, classes, filters = 4, 32, 10, 8
+
+    batch_size = batch_per_chip * n_dev
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    model = ResNet50(num_classes=classes, num_filters=filters, dtype=jnp.float32)
+
+    rng = jax.random.PRNGKey(0)
+    x0 = jnp.zeros((batch_per_chip, hw, hw, 3), jnp.float32)
+    variables = model.init(rng, x0, train=False)
+    params, bstats = variables["params"], variables["batch_stats"]
+
+    images = jax.random.normal(jax.random.PRNGKey(1), (batch_size, hw, hw, 3))
+    labels = jax.random.randint(jax.random.PRNGKey(2), (batch_size,), 0, classes)
+    batch = shard_batch({"image": images, "label": labels}, mesh)
+
+    tx = optax.sgd(0.1, momentum=0.9)
+    loss_fn = classification_loss_fn(model)
+
+    # --- framework step (scheduled bucketed push_pull)
+    step = make_data_parallel_step(loss_fn, tx, mesh)
+    state = step.init_state(params, model_state={"batch_stats": bstats})
+    # build the baseline state BEFORE timing: the framework step donates its
+    # input buffers, so params/bstats must be materialized for both first
+    from byteps_tpu.training.step import replicate_state
+
+    pstate = replicate_state(
+        jax.tree_util.tree_map(
+            lambda x: jnp.array(x, copy=True),
+            (params, tx.init(params), {"batch_stats": bstats}),
+        ),
+        mesh,
+    )
+    t_fw = _time_steps(step, state, batch, ITERS)
+
+    # --- plain-jax baseline: same model/optimizer, naive jax.grad + psum-free
+    #     single-program step (the no-scheduler Horovod analog)
+    from byteps_tpu.parallel.collectives import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def plain_local(state, batch):
+        params, opt_state, mstate = state
+
+        def lf(p):
+            return loss_fn(p, mstate, batch)
+
+        (loss, new_mstate), grads = jax.value_and_grad(lf, has_aux=True)(params)
+        grads = jax.tree_util.tree_map(
+            lambda g: jax.lax.pmean(g, "dp"), grads
+        )
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        new_mstate = jax.tree_util.tree_map(
+            lambda x: jax.lax.pmean(x, "dp")
+            if jnp.issubdtype(x.dtype, jnp.floating) else x,
+            new_mstate,
+        )
+        return (params, opt_state, new_mstate), jax.lax.pmean(loss, "dp")
+
+    plain = jax.jit(
+        shard_map(
+            plain_local, mesh, in_specs=(P(), P("dp")), out_specs=(P(), P())
+        ),
+        donate_argnums=(0,),
+    )
+
+    def plain_fn(state, batch):
+        state, loss = plain(state, batch)
+        return state, {"loss": loss}
+
+    t_plain = _time_steps(plain_fn, pstate, batch, ITERS)
+
+    ips = batch_size / t_fw
+    ips_plain = batch_size / t_plain
+    print(
+        json.dumps(
+            {
+                "metric": f"resnet50_fp32_b{batch_per_chip}_images_per_sec"
+                + ("" if on_tpu else "_cpusmoke"),
+                "value": round(ips, 2),
+                "unit": "images/sec",
+                "vs_baseline": round(ips / ips_plain, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

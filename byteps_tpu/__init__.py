@@ -22,6 +22,7 @@ from .api import (  # noqa: F401
     init,
     local_rank,
     local_size,
+    mesh,
     poll,
     push_pull,
     push_pull_async,

@@ -34,6 +34,36 @@ from .parallel import collectives as _collectives
 from .parallel import mesh as _mesh_mod
 
 
+def _maybe_distributed_init() -> None:
+    """Multi-host bootstrap: if launched via byteps_tpu.launcher (or with the
+    BYTEPS_COORDINATOR_ADDR contract set by hand), bring up JAX's distributed
+    runtime — the replacement for the reference's DMLC scheduler rendezvous
+    (ps::StartAsync + barrier, global.cc:197-212)."""
+    import os
+
+    if os.environ.get("BYTEPS_DISTRIBUTED_INIT", "0") != "1":
+        return
+    # NB: do NOT probe jax.process_count() here — it initializes the XLA
+    # backend, after which jax.distributed.initialize() always raises.
+    try:
+        from jax._src import distributed as _jax_dist
+
+        if getattr(_jax_dist.global_state, "client", None) is not None:
+            return  # already initialized
+    except Exception:
+        pass
+    addr = os.environ.get("BYTEPS_COORDINATOR_ADDR")
+    nproc = int(os.environ.get("BYTEPS_NUM_PROCESSES", "1"))
+    pid = int(os.environ.get("BYTEPS_PROCESS_ID", "0"))
+    if addr and nproc > 1:
+        jax.distributed.initialize(
+            coordinator_address=addr, num_processes=nproc, process_id=pid
+        )
+        bps_log.info(
+            "jax.distributed initialized: process %d/%d via %s", pid, nproc, addr
+        )
+
+
 class _GlobalState:
     def __init__(self):
         self.initialized = False
@@ -59,6 +89,7 @@ def init(
     with _state.lock:
         if _state.initialized:
             return
+        _maybe_distributed_init()
         cfg = get_config()
         if mesh is None:
             shape = mesh_shape or _mesh_mod.parse_mesh_shape(cfg.mesh_shape)
@@ -82,6 +113,9 @@ def shutdown() -> None:
         _state.mesh = None
         _state.reduce_axes = []
         _state.initialized = False
+        from .common.tracing import reset_tracer
+
+        reset_tracer()  # flushes the chrome trace if enabled
         reset_config()
 
 

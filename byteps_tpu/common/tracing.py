@@ -1,0 +1,136 @@
+"""Tracing / profiling subsystem.
+
+The reference's observability (SURVEY.md §5): a server-side Chrome-trace
+timeline of per-key push/pull begin/end events (``BYTEPS_SERVER_ENABLE_PROFILE``,
+docs/timeline.md) plus TRACE-level queue logging.  Here:
+
+  * ``Tracer`` — a process-wide Chrome-trace event recorder.  The engine
+    records begin/end per (task, stage) so ``chrome://tracing`` /
+    Perfetto render the same per-key timeline the reference emits.
+    Enable with ``BYTEPS_TRACE_PATH=/tmp/bps_trace.json`` (the analog of
+    ``BYTEPS_SERVER_PROFILE_OUTPUT_PATH``); filter to one key with
+    ``BYTEPS_SERVER_KEY_TO_PROFILE``-style arg to ``Tracer(key_filter=)``.
+  * ``annotate`` — ``jax.profiler.TraceAnnotation`` wrapper so jitted-step
+    stages show up named in TPU XProf traces (the SURVEY §5 prescription:
+    "jax.profiler traces + per-stage named XLA computations").
+  * on-device step timing helpers for the bench harness.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+from .config import get_config
+
+
+class Tracer:
+    """Chrome-trace ("trace event format") recorder, thread-safe.
+
+    Events are complete-events ("ph": "X") with microsecond timestamps, one
+    row (tid) per pipeline stage — mirroring the reference's
+    push/pull-per-key rows (docs/timeline.md).
+    """
+
+    def __init__(self, path: str = "", key_filter: str = ""):
+        self.path = path
+        self.key_filter = key_filter
+        self._events: List[dict] = []
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.path)
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    @contextmanager
+    def span(self, name: str, stage: str, key: Optional[int] = None, **args):
+        if not self.enabled or (self.key_filter and self.key_filter not in name):
+            yield
+            return
+        t0 = self._now_us()
+        try:
+            yield
+        finally:
+            t1 = self._now_us()
+            with self._lock:
+                self._events.append(
+                    {
+                        "name": name,
+                        "cat": stage,
+                        "ph": "X",
+                        "ts": t0,
+                        "dur": t1 - t0,
+                        "pid": os.getpid(),
+                        "tid": stage,
+                        "args": {"key": key, **args},
+                    }
+                )
+
+    def instant(self, name: str, stage: str, **args) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._events.append(
+                {
+                    "name": name,
+                    "cat": stage,
+                    "ph": "i",
+                    "s": "p",
+                    "ts": self._now_us(),
+                    "pid": os.getpid(),
+                    "tid": stage,
+                    "args": args,
+                }
+            )
+
+    def flush(self, path: Optional[str] = None) -> Optional[str]:
+        """Write accumulated events as Chrome-trace JSON; returns the path."""
+        path = path or self.path
+        if not path:
+            return None
+        with self._lock:
+            payload = {"traceEvents": list(self._events)}
+        with open(path, "w") as f:
+            json.dump(payload, f)
+        return path
+
+    def events(self) -> List[dict]:
+        with self._lock:
+            return list(self._events)
+
+
+_tracer: Optional[Tracer] = None
+_tracer_lock = threading.Lock()
+
+
+def get_tracer() -> Tracer:
+    global _tracer
+    with _tracer_lock:
+        if _tracer is None:
+            _tracer = Tracer(path=get_config().trace_path)
+        return _tracer
+
+
+def reset_tracer() -> None:
+    global _tracer
+    with _tracer_lock:
+        if _tracer is not None and _tracer.enabled:
+            _tracer.flush()
+        _tracer = None
+
+
+@contextmanager
+def annotate(name: str):
+    """Named region in TPU XProf traces (jax.profiler.TraceAnnotation)."""
+    import jax.profiler
+
+    with jax.profiler.TraceAnnotation(name):
+        yield

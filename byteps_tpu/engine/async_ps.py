@@ -1,0 +1,138 @@
+"""Asynchronous parameter-server mode (reference ``BYTEPS_ENABLE_ASYNC``).
+
+Reference semantics (torch/__init__.py:174-189, mxnet/__init__.py:70-90,
+docs/env.md "Asynchronous training"): each worker runs its *local* optimizer
+step, pushes the resulting **weight delta** (new - last_pulled) to CPU
+server processes which add it into the global weights, and pulls back the
+current global state — no global barrier, so fast workers never wait for
+stragglers; gradients are applied stale.
+
+TPU-native rendering: the "server tier" is a host-side store (HBM-external,
+like the reference's CPU servers).  Under single-controller JAX the store
+lives in host RAM of the controller process; in a multi-host deployment each
+process holds the shard of the store for its own key range (the analog of
+the reference's key->server sharding, global.cc:305-334) and exchanges
+deltas over DCN via ``jax.experimental.multihost_utils`` — the hot
+summation loop optionally runs in the native C++ reducer
+(byteps_tpu/native, OpenMP), mirroring the reference's cpu_reducer.cc role
+on the server.
+
+Staleness contract (tested in tests/test_async_ps.py): after any sequence
+of interleaved worker push_pulls, global_state == initial + sum of all
+pushed deltas; a worker's pull reflects at least its own past pushes
+(read-your-writes).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..common import logging as bps_log
+
+
+class AsyncParameterServer:
+    """Host-side global parameter store summing weight deltas.
+
+    One flat fp32/orig-dtype numpy buffer per declared tensor; ``push_pull``
+    is atomic per tensor (mutex), matching the reference server's per-key
+    atomic updates (SURVEY.md §1 "server sums").
+    """
+
+    def __init__(self, use_native: bool = True):
+        self._store: Dict[str, np.ndarray] = {}
+        self._locks: Dict[str, threading.Lock] = {}
+        self._global_lock = threading.Lock()
+        self._version: Dict[str, int] = {}
+        self._reducer = None
+        if use_native:
+            try:
+                from ..native import reducer as native_reducer
+
+                self._reducer = native_reducer if native_reducer.available() else None
+            except Exception:
+                self._reducer = None
+
+    # -------------------------------------------------------------- tensors
+
+    def init_tensor(self, name: str, value: np.ndarray) -> None:
+        """First-push-wins initialization (reference InitTensor's blocking
+        initial push, operations.cc:262-284)."""
+        with self._global_lock:
+            if name not in self._store:
+                self._store[name] = np.array(value, copy=True)
+                self._locks[name] = threading.Lock()
+                self._version[name] = 0
+
+    def _accumulate(self, dst: np.ndarray, delta: np.ndarray) -> None:
+        if self._reducer is not None and dst.dtype in (np.float32, np.float16):
+            self._reducer.sum_into(dst, delta)
+        else:
+            dst += delta
+
+    def push_delta(self, name: str, delta: np.ndarray) -> None:
+        with self._locks[name]:
+            self._accumulate(self._store[name], np.asarray(delta, self._store[name].dtype))
+            self._version[name] += 1
+
+    def pull(self, name: str) -> np.ndarray:
+        with self._locks[name]:
+            return self._store[name].copy()
+
+    def push_pull(self, name: str, delta: np.ndarray) -> np.ndarray:
+        """Atomic add-then-read (what the reference's paired ZPush/ZPull pair
+        achieves per key, core_loops.cc:430-502)."""
+        with self._locks[name]:
+            self._accumulate(self._store[name], np.asarray(delta, self._store[name].dtype))
+            self._version[name] += 1
+            return self._store[name].copy()
+
+    def version(self, name: str) -> int:
+        with self._locks[name]:
+            return self._version[name]
+
+    def names(self) -> List[str]:
+        with self._global_lock:
+            return list(self._store)
+
+
+class AsyncWorker:
+    """Per-worker view implementing the reference's async training loop.
+
+    Usage (mirrors torch/__init__.py:174-189)::
+
+        worker = AsyncWorker(server, params)       # registers + pulls
+        for step:
+            new_params = local_optimizer_step(worker.params, batch)
+            worker.push_pull(new_params)           # delta push, global pull
+            # worker.params is now the fresh global state
+
+    ``params`` is any pytree of arrays; tree structure must match across
+    workers (same declared names — reference's name-sorted declaration,
+    torch/__init__.py:90-95).
+    """
+
+    def __init__(self, server: AsyncParameterServer, params: Any, worker_id: int = 0):
+        self.server = server
+        self.worker_id = worker_id
+        self.treedef = jax.tree_util.tree_structure(params)
+        leaves = jax.tree_util.tree_leaves(params)
+        self._names = [f"param_{i}" for i in range(len(leaves))]
+        for name, leaf in zip(self._names, leaves):
+            server.init_tensor(name, np.asarray(leaf))
+        # snapshot of the state this worker last pulled: deltas are vs this
+        self._snapshot = [np.array(np.asarray(l), copy=True) for l in leaves]
+        self.params = params
+
+    def push_pull(self, new_params: Any) -> Any:
+        new_leaves = [np.asarray(l) for l in jax.tree_util.tree_leaves(new_params)]
+        pulled = []
+        for name, new, snap in zip(self._names, new_leaves, self._snapshot):
+            delta = new - snap
+            pulled.append(self.server.push_pull(name, delta))
+        self._snapshot = [p.copy() for p in pulled]
+        self.params = jax.tree_util.tree_unflatten(self.treedef, pulled)
+        return self.params

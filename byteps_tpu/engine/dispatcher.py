@@ -33,6 +33,7 @@ import numpy as np
 
 from ..common import logging as bps_log
 from ..common.config import get_config
+from ..common.tracing import get_tracer
 from ..common.context import TensorRegistry, partition_key
 from ..common.partition import partition_offsets
 from ..common.scheduler import ScheduledQueue
@@ -168,6 +169,7 @@ class Engine:
         """Grant tasks in priority/credit order and launch their collectives
         (the analog of RunRootNcclLoopOnce + RunPushLoopOnce, but a launch is
         just an async XLA dispatch)."""
+        tracer = get_tracer()  # stable until shutdown; avoid per-task locking
         while not self._shutdown.is_set():
             task = self.queue.wait_task(timeout=0.25)
             if task is None:
@@ -175,7 +177,9 @@ class Engine:
             if task.name == "__poison__":
                 break
             try:
-                result = self._launch(task)
+                with tracer.span(task.name, "dispatch", key=task.key,
+                                 bytes=task.length):
+                    result = self._launch(task)
                 task.output = result
                 self._completion_q.put(task)
             except Exception as e:  # pragma: no cover
@@ -198,17 +202,32 @@ class Engine:
     def _completion_loop(self) -> None:
         """Block on launched collectives, return credits, assemble outputs,
         fire callbacks (FinishOrProceed, core_loops.cc:27-82)."""
+        tracer = get_tracer()
         while True:
             task = self._completion_q.get()
             if task is None:
                 self._completion_q.put(None)  # let sibling completers exit
                 return
             try:
-                jax.block_until_ready(task.output)
+                with tracer.span(task.name, "push_pull", key=task.key,
+                                 bytes=task.length):
+                    jax.block_until_ready(task.output)
                 status = Status.OK()
             except Exception as e:  # pragma: no cover
                 status = Status.UnknownError(str(e))
             self.queue.report_finish(task)
+            sample = get_config().debug_sample_tensor
+            if sample and sample in task.name:
+                # reference BYTEPS_DEBUG_SAMPLE_TENSOR (core_loops.cc:33-63):
+                # print first/last values after the stage completes
+                try:
+                    flat = np.asarray(task.output).reshape(-1)
+                    bps_log.info(
+                        "sample %s key=%d first=%s last=%s", task.name,
+                        task.key, flat[0], flat[-1],
+                    )
+                except Exception:
+                    pass
             req: _PushPullRequest = task.request  # type: ignore[attr-defined]
             with req.lock:
                 req.chunks[task.partition_index] = task.output

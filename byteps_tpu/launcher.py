@@ -1,0 +1,91 @@
+"""Process launcher — the TPU-native replacement for ``launcher/launch.py``.
+
+The reference spawns one worker process per GPU plus CPU server/scheduler
+processes, wired together by the ``DMLC_*`` env contract
+(launcher/launch.py:10-64).  On TPU the model is one process per *host*
+(SPMD single program; devices are addressed via the mesh), and there is no
+server/scheduler role — XLA collectives over ICI/DCN replace ps-lite, and
+JAX's own coordination service replaces the DMLC scheduler.
+
+The same env names keep working so reference run scripts port directly:
+
+  DMLC_PS_ROOT_URI / DMLC_PS_ROOT_PORT  -> coordinator address
+  DMLC_WORKER_ID                        -> process index
+  DMLC_NUM_WORKER                       -> process count
+  DMLC_ROLE                             -> must be "worker" (server/scheduler
+                                           roles are accepted and exit 0 with
+                                           a notice — they are obsolete here)
+  BYTEPS_ENABLE_GDB=1                   -> wrap the command in gdb
+                                           (launcher/launch.py:37-40)
+
+Usage::
+
+    DMLC_NUM_WORKER=2 DMLC_WORKER_ID=0 DMLC_PS_ROOT_URI=10.0.0.1 \
+        python -m byteps_tpu.launcher python train.py ...
+
+The child inherits ``BYTEPS_DISTRIBUTED_INIT=1`` which makes
+``byteps_tpu.init()`` call ``jax.distributed.initialize`` with the derived
+settings before building the mesh.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def _check_env(env: dict) -> None:
+    """Validate the cluster contract (reference launch.py:10-31)."""
+    required = ["DMLC_NUM_WORKER", "DMLC_ROLE"]
+    if int(env.get("DMLC_NUM_WORKER", "1")) > 1:
+        required += ["DMLC_WORKER_ID", "DMLC_PS_ROOT_URI", "DMLC_PS_ROOT_PORT"]
+    missing = [k for k in required if k not in env]
+    if missing:
+        raise SystemExit(
+            f"byteps_tpu.launcher: missing required env: {', '.join(missing)}"
+        )
+
+
+def build_child_env(env: dict) -> dict:
+    child = dict(env)
+    nproc = int(env.get("DMLC_NUM_WORKER", "1"))
+    if nproc > 1:
+        uri = env["DMLC_PS_ROOT_URI"]
+        port = env.get("DMLC_PS_ROOT_PORT", "1234")
+        child["BYTEPS_COORDINATOR_ADDR"] = f"{uri}:{port}"
+        child["BYTEPS_NUM_PROCESSES"] = str(nproc)
+        child["BYTEPS_PROCESS_ID"] = env.get("DMLC_WORKER_ID", "0")
+        child["BYTEPS_DISTRIBUTED_INIT"] = "1"
+    child.setdefault("BYTEPS_LOCAL_RANK", "0")
+    child.setdefault("BYTEPS_LOCAL_SIZE", "1")
+    return child
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    env = dict(os.environ)
+    env.setdefault("DMLC_ROLE", "worker")
+    role = env["DMLC_ROLE"]
+    if role in ("server", "scheduler"):
+        # obsolete roles: the PS tier is replaced by XLA collectives / the
+        # in-process async-PS store (reference launch.py:62-64 started a
+        # whole MXNet KVStore here)
+        print(
+            f"byteps_tpu.launcher: role '{role}' is not needed on TPU "
+            "(XLA collectives replace the parameter-server tier); exiting."
+        )
+        return 0
+    if not argv:
+        raise SystemExit("usage: python -m byteps_tpu.launcher COMMAND [ARGS...]")
+    _check_env(env)
+    child_env = build_child_env(env)
+    cmd = list(argv)
+    if child_env.get("BYTEPS_ENABLE_GDB", "0") == "1":
+        cmd = ["gdb", "-ex", "run", "-ex", "bt", "-batch", "--args"] + cmd
+    proc = subprocess.Popen(cmd, env=child_env)
+    return proc.wait()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,18 @@
+"""Model zoo for benchmarks and examples.
+
+The reference ships its models as examples (``example/pytorch/benchmark_byteps.py``
+pulls torchvision ResNet50/VGG16; SURVEY.md §6 headline numbers are ResNet50
+and VGG16 images/sec).  Here the models are first-class, TPU-native flax
+modules: NHWC layouts, bf16-friendly compute dtype, static shapes, and no
+Python control flow under jit.
+"""
+
+from .resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101, ResNet152
+from .vgg import VGG, VGG11, VGG16, VGG19
+from .transformer import Transformer, TransformerConfig
+
+__all__ = [
+    "ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101", "ResNet152",
+    "VGG", "VGG11", "VGG16", "VGG19",
+    "Transformer", "TransformerConfig",
+]

@@ -1,0 +1,179 @@
+"""Decoder-only Transformer with first-class dp x tp x sp parallelism.
+
+The reference never partitions along model dimensions (SURVEY.md §2.4 "Not
+present": tensor/sequence parallelism) — this model is the TPU-native
+generalization the rebuild treats as first-class.  Parallel design, following
+the scaling-book recipe (mesh + annotated shardings + XLA collectives):
+
+* **dp**: batch dim sharded over ``dp`` via input shardings; gradient
+  reduction is XLA's automatic psum (or the framework's scheduled push_pull
+  when driven through ``shard_map``).
+* **tp**: attention heads and MLP hidden dim sharded over ``tp`` with
+  ``nn.with_partitioning`` kernel annotations — XLA's SPMD partitioner
+  inserts the reduce-scatter/all-reduce pairs (Megatron-style column/row
+  split) on ICI.
+* **sp**: the sequence dim sharded over ``sp``; exact attention runs as ring
+  attention (``lax.ppermute`` K/V rotation) or Ulysses (``all_to_all``)
+  inside a ``shard_map`` island — see parallel/ring_attention.py.
+
+Everything is static-shaped; the only loop is over layers (unrolled at
+trace time — layer count is small and static).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..parallel.ring_attention import (
+    local_attention,
+    ring_attention,
+    ulysses_attention,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    num_layers: int = 4
+    num_heads: int = 8
+    d_model: int = 512
+    d_ff: int = 2048
+    max_seq_len: int = 2048
+    dtype: Any = jnp.bfloat16
+    attn_impl: str = "local"  # local | ring | ulysses
+    # mesh axis names; attention shard_map uses (dp_axis, sp_axis, tp_axis)
+    dp_axis: str = "dp"
+    sp_axis: str = "sp"
+    tp_axis: str = "tp"
+    mesh: Optional[Mesh] = None
+
+    def partition(self, init, spec):
+        """Wrap an initializer with tp-sharding metadata — only when this
+        config's mesh actually has the tp axis (flax re-applies the
+        constraint at apply time, so a dangling axis name would fail under
+        a dp-only mesh)."""
+        if self.mesh is not None and self.tp_axis in self.mesh.axis_names:
+            return nn.with_partitioning(init, spec)
+        return init
+
+    def attention_fn(self):
+        if self.attn_impl == "local" or self.mesh is None:
+            return lambda q, k, v: local_attention(q, k, v, causal=True)
+        inner = ring_attention if self.attn_impl == "ring" else ulysses_attention
+        mesh = self.mesh
+        names = set(mesh.axis_names)
+        if self.sp_axis not in names:
+            return lambda q, k, v: local_attention(q, k, v, causal=True)
+        spec = P(
+            self.dp_axis if self.dp_axis in names else None,
+            self.sp_axis,
+            self.tp_axis if self.tp_axis in names else None,
+            None,
+        )
+
+        fn = partial(inner, axis_name=self.sp_axis, causal=True)
+        try:  # jax >= 0.6
+            smap = partial(
+                jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+                out_specs=spec, check_vma=False,
+            )
+        except Exception:  # pragma: no cover
+            from jax.experimental.shard_map import shard_map as _sm
+
+            smap = partial(
+                _sm, mesh=mesh, in_specs=(spec, spec, spec),
+                out_specs=spec, check_rep=False,
+            )
+        return smap(fn)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        H, D = cfg.num_heads, cfg.d_model // cfg.num_heads
+        proj = partial(
+            nn.DenseGeneral, dtype=cfg.dtype, use_bias=False,
+            kernel_init=cfg.partition(
+                nn.initializers.xavier_uniform(), (None, cfg.tp_axis, None)
+            ),
+        )
+        q = proj(features=(H, D), name="q")(x)
+        k = proj(features=(H, D), name="k")(x)
+        v = proj(features=(H, D), name="v")(x)
+        out = cfg.attention_fn()(q, k, v)
+        return nn.DenseGeneral(
+            features=cfg.d_model, axis=(-2, -1), dtype=cfg.dtype,
+            use_bias=False, name="o",
+            kernel_init=cfg.partition(
+                nn.initializers.xavier_uniform(), (cfg.tp_axis, None, None)
+            ),
+        )(out)
+
+
+class MLP(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = nn.Dense(
+            cfg.d_ff, dtype=cfg.dtype, use_bias=False, name="up",
+            kernel_init=cfg.partition(
+                nn.initializers.xavier_uniform(), (None, cfg.tp_axis)
+            ),
+        )(x)
+        h = nn.gelu(h)
+        return nn.Dense(
+            cfg.d_model, dtype=cfg.dtype, use_bias=False, name="down",
+            kernel_init=cfg.partition(
+                nn.initializers.xavier_uniform(), (cfg.tp_axis, None)
+            ),
+        )(h)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.RMSNorm(dtype=self.cfg.dtype, name="ln1")(x)
+        x = x + Attention(self.cfg, name="attn")(y)
+        y = nn.RMSNorm(dtype=self.cfg.dtype, name="ln2")(x)
+        return x + MLP(self.cfg, name="mlp")(y)
+
+
+class Transformer(nn.Module):
+    """Causal LM.  Input ``tokens [B, T]`` -> logits ``[B, T, vocab]``."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        x = nn.Embed(
+            cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, name="embed",
+            embedding_init=cfg.partition(
+                nn.initializers.normal(stddev=0.02), (None, None)
+            ),
+        )(tokens)
+        pos = nn.Embed(
+            cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype, name="pos",
+        )(jnp.arange(tokens.shape[1])[None, :])
+        x = x + pos
+        for i in range(cfg.num_layers):
+            x = Block(cfg, name=f"block_{i}")(x)
+        x = nn.RMSNorm(dtype=cfg.dtype, name="ln_f")(x)
+        logits = nn.Dense(
+            cfg.vocab_size, dtype=jnp.float32, use_bias=False, name="lm_head",
+        )(x)
+        return logits.astype(jnp.float32)

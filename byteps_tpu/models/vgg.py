@@ -1,0 +1,59 @@
+"""VGG family — the reference's *communication-bound* headline benchmark
+(README.md:22-26: VGG16 fp32 BS 64/GPU, where BytePS shows its biggest win,
++100% over Horovod, because the ~138M-parameter fc layers saturate the wire).
+
+Kept faithful to that character: the classifier is the full
+flatten -> 4096 -> 4096 -> classes stack (the 102M-element fc1 is exactly the
+tensor the reference's partitioner exists for: it splits into
+ceil(411MB / BYTEPS_PARTITION_BYTES) ~= 100 pipelined partitions,
+operations.cc:95-132 — ours becomes ~100 scheduled bucket collectives).
+NHWC, bf16-friendly, static shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class VGG(nn.Module):
+    """VGG with batch-norm-free conv stacks, NHWC.
+
+    ``stage_sizes[i]`` 3x3 convs at ``channels[i]`` filters, maxpool between
+    stages, then the canonical 4096-4096 classifier.
+    """
+
+    stage_sizes: Sequence[int]
+    channels: Sequence[int] = (64, 128, 256, 512, 512)
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+    dropout_rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        for i, reps in enumerate(self.stage_sizes):
+            for j in range(reps):
+                x = nn.Conv(
+                    self.channels[i], (3, 3), padding="SAME",
+                    dtype=self.dtype, name=f"conv{i}_{j}",
+                )(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(4096, dtype=self.dtype, name="fc1")(x)
+        x = nn.relu(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(4096, dtype=self.dtype, name="fc2")(x)
+        x = nn.relu(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype, name="fc3")(x)
+        return x.astype(jnp.float32)
+
+
+VGG11 = functools.partial(VGG, stage_sizes=[1, 1, 2, 2, 2])
+VGG16 = functools.partial(VGG, stage_sizes=[2, 2, 3, 3, 3])
+VGG19 = functools.partial(VGG, stage_sizes=[2, 2, 4, 4, 4])

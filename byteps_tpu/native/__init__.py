@@ -1,0 +1,12 @@
+"""byteps_tpu.native — ctypes bindings to the C++ host runtime (csrc/).
+
+The reference's runtime is ~4k LoC of C++ (SURVEY.md §2.1); the TPU rebuild
+keeps native code where it still earns its keep off-accelerator: the
+async-PS server summation loop (cpu_reducer analog), fp16/bf16 software
+arithmetic, and the key->shard hash.  The library is compiled on demand
+with g++ (no pybind11 in this image — pure C ABI + ctypes).
+"""
+
+from . import reducer  # noqa: F401
+
+__all__ = ["reducer"]

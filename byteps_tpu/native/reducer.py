@@ -1,0 +1,122 @@
+"""ctypes loader for libbyteps_native.so with build-on-first-use.
+
+API:
+  available() -> bool
+  sum_into(dst, src)           # dst += src elementwise, OpenMP-parallel
+  key_to_shard(key, n) -> int  # reference global.cc:305-334 hash
+  omp_max_threads() -> int
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ..common import logging as bps_log
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "libbyteps_native.so")
+_SRC = os.path.normpath(os.path.join(_HERE, "..", "..", "csrc", "byteps_native.cc"))
+
+_lib: Optional[ctypes.CDLL] = None
+_lock = threading.Lock()
+_build_failed = False
+
+
+def _build() -> bool:
+    """Compile the native lib in place (g++ is in the baked image)."""
+    cmd = [
+        os.environ.get("CXX", "g++"),
+        "-O3", "-march=native", "-fopenmp", "-fPIC", "-std=c++17",
+        "-shared", "-o", _SO, _SRC,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except Exception as e:  # pragma: no cover
+        bps_log.warning("native build failed (%s); using numpy fallback", e)
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        if not os.path.exists(_SO) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
+        ):
+            if not os.path.exists(_SRC) or not _build():
+                _build_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError as e:  # pragma: no cover
+            bps_log.warning("native load failed: %s", e)
+            _build_failed = True
+            return None
+        for name, argtypes in [
+            ("bps_sum_f32", [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]),
+            ("bps_sum_f64", [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]),
+            ("bps_sum_f16", [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]),
+            ("bps_sum_bf16", [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]),
+            ("bps_sum_i32", [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]),
+            ("bps_sum_i64", [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]),
+        ]:
+            getattr(lib, name).argtypes = argtypes
+            getattr(lib, name).restype = None
+        lib.bps_key_to_shard.argtypes = [ctypes.c_uint64, ctypes.c_int64]
+        lib.bps_key_to_shard.restype = ctypes.c_int64
+        lib.bps_omp_max_threads.restype = ctypes.c_int
+        lib.bps_abi_version.restype = ctypes.c_int
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+_SUM_FN = {
+    np.dtype(np.float32): "bps_sum_f32",
+    np.dtype(np.float64): "bps_sum_f64",
+    np.dtype(np.float16): "bps_sum_f16",
+    np.dtype(np.int32): "bps_sum_i32",
+    np.dtype(np.int64): "bps_sum_i64",
+}
+
+
+def sum_into(dst: np.ndarray, src: np.ndarray) -> None:
+    """dst += src, OpenMP-parallel (reference CpuReducer::sum,
+    cpu_reducer.cc:41-155).  Falls back to numpy if the lib is missing."""
+    lib = _load()
+    src = np.ascontiguousarray(src, dtype=dst.dtype)
+    fn_name = _SUM_FN.get(dst.dtype)
+    if lib is None or fn_name is None or not dst.flags.c_contiguous:
+        dst += src
+        return
+    getattr(lib, fn_name)(
+        dst.ctypes.data_as(ctypes.c_void_p),
+        src.ctypes.data_as(ctypes.c_void_p),
+        ctypes.c_int64(dst.size),
+    )
+
+
+def key_to_shard(key: int, num_shards: int) -> int:
+    lib = _load()
+    if lib is None:
+        return (((key >> 16) + (key % 65536)) * 9973) % max(num_shards, 1)
+    return int(lib.bps_key_to_shard(key, num_shards))
+
+
+def omp_max_threads() -> int:
+    lib = _load()
+    return int(lib.bps_omp_max_threads()) if lib is not None else 1

@@ -12,10 +12,12 @@ from .collectives import (
     replicate,
     shard_map,
 )
+from .ring_attention import local_attention, ring_attention, ulysses_attention
 
 __all__ = [
     "AXIS_ORDER", "build_mesh", "parse_mesh_shape", "reduce_axes",
     "axis_size", "world_size",
     "push_pull_shard", "push_pull_tree", "push_pull_stacked",
     "broadcast_shard", "broadcast_stacked", "replicate", "shard_map",
+    "ring_attention", "ulysses_attention", "local_attention",
 ]

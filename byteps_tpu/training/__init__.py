@@ -2,5 +2,17 @@
 callbacks."""
 
 from .optimizer import DistributedOptimizer, push_pull_gradients
+from .step import (
+    TrainState,
+    classification_loss_fn,
+    create_train_state,
+    make_data_parallel_step,
+    replicate_state,
+    shard_batch,
+)
 
-__all__ = ["DistributedOptimizer", "push_pull_gradients"]
+__all__ = [
+    "DistributedOptimizer", "push_pull_gradients",
+    "TrainState", "create_train_state", "make_data_parallel_step",
+    "shard_batch", "replicate_state", "classification_loss_fn",
+]

@@ -1,0 +1,141 @@
+"""Training callbacks / schedules — the Keras-callback surface of the
+reference (_keras/callbacks.py:21-171, keras/callbacks.py) re-expressed for
+a JAX training loop:
+
+  * ``BroadcastGlobalVariablesCallback``  -> ``broadcast_parameters`` at
+    step 0 (consistent init / checkpoint resume);
+  * ``MetricAverageCallback``             -> ``average_metrics`` (push_pull
+    of metric values across workers at epoch end);
+  * ``LearningRateScheduleCallback`` and ``LearningRateWarmupCallback`` ->
+    optax schedules via ``warmup_schedule`` / ``scaled_lr`` with the same
+    momentum-correction option the reference applies when the LR changes
+    mid-run (_keras/callbacks.py:116-171).
+
+The linear-scaling + warmup recipe (Goyal et al.) is what the reference's
+warmup callback implements: lr ramps from ``initial_lr`` to
+``initial_lr * size()`` over ``warmup_epochs``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def scaled_lr(base_lr: float, world_size: int) -> float:
+    """Linear LR scaling with worker count (reference docstring advice in
+    _keras/callbacks.py:84-96)."""
+    return base_lr * world_size
+
+
+def warmup_schedule(
+    base_lr: float,
+    world_size: int,
+    warmup_steps: int,
+    after: Optional[optax.Schedule] = None,
+) -> optax.Schedule:
+    """LR warmup from ``base_lr`` to ``base_lr * world_size`` over
+    ``warmup_steps`` (reference LearningRateWarmupCallback semantics:
+    gradual ramp to the scaled rate), then hand off to ``after`` (default:
+    constant scaled rate)."""
+    peak = scaled_lr(base_lr, world_size)
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        frac = jnp.clip(step / jnp.maximum(warmup_steps, 1), 0.0, 1.0)
+        warm = base_lr + (peak - base_lr) * frac
+        if after is None:
+            return warm
+        return jnp.where(step < warmup_steps, warm, after(step - warmup_steps))
+
+    return schedule
+
+
+def multiplier_schedule(
+    base_lr: float, multipliers: Dict[int, float]
+) -> optax.Schedule:
+    """Staircase schedule from {start_epoch_step: multiplier} — the
+    reference's ``LearningRateScheduleCallback`` with ``staircase=True``
+    (_keras/callbacks.py:98-140)."""
+    boundaries = sorted(multipliers)
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.int32)
+        mult = jnp.asarray(1.0, jnp.float32)
+        for b in boundaries:
+            mult = jnp.where(step >= b, jnp.asarray(multipliers[b], jnp.float32), mult)
+        return base_lr * mult
+
+    return schedule
+
+
+def momentum_corrected_sgd(
+    schedule: optax.Schedule, momentum: float = 0.9
+) -> optax.GradientTransformation:
+    """SGD whose momentum buffer is rescaled when the LR changes — the
+    reference's ``momentum_correction`` (_keras/callbacks.py:143-171):
+    on an LR change from lr0 to lr1 the velocity is multiplied by lr1/lr0 so
+    the effective update magnitude tracks the new rate immediately."""
+
+    def init_fn(params):
+        return {
+            "trace": jax.tree_util.tree_map(jnp.zeros_like, params),
+            "step": jnp.zeros((), jnp.int32),
+            "prev_lr": jnp.asarray(schedule(0), jnp.float32),
+        }
+
+    def update_fn(updates, state, params=None):
+        del params
+        lr = jnp.asarray(schedule(state["step"]), jnp.float32)
+        correction = lr / jnp.maximum(state["prev_lr"], 1e-30)
+        new_trace = jax.tree_util.tree_map(
+            lambda t, g: t * momentum * correction + g, state["trace"], updates
+        )
+        out = jax.tree_util.tree_map(lambda t: -lr * t, new_trace)
+        return out, {
+            "trace": new_trace,
+            "step": state["step"] + 1,
+            "prev_lr": lr,
+        }
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def average_metrics(metrics: Dict[str, Union[float, jax.Array]]) -> Dict[str, float]:
+    """Average scalar metrics across workers at epoch end — the reference's
+    ``MetricAverageCallback`` (_keras/callbacks.py:36-70, push_pull of
+    metric variables).  Uses the eager push_pull path; in single-process
+    runs with one logical worker this is the identity."""
+    import byteps_tpu as bps
+
+    n = bps.size()
+    out = {}
+    for k, v in metrics.items():
+        v = jnp.asarray(v, jnp.float32)
+        if n == 1:
+            out[k] = float(v)
+        else:
+            out[k] = float(bps.push_pull(jnp.broadcast_to(v, (n,)), average=True,
+                                         name=f"metric.{k}"))
+    return out
+
+
+class BroadcastGlobalVariablesCallback:
+    """Callable hook: at the first step, broadcast params/opt state from the
+    root so every worker starts identically (reference
+    keras/callbacks.py:28-31 — also the checkpoint-resume path)."""
+
+    def __init__(self, root_rank: int = 0):
+        self.root_rank = root_rank
+        self._done = False
+
+    def __call__(self, state):
+        if self._done:
+            return state
+        import byteps_tpu as bps
+
+        self._done = True
+        return bps.broadcast_parameters(state, root_rank=self.root_rank)

@@ -61,6 +61,14 @@ def push_pull_gradients(
         if axis_name is None:
             return updates, state
         axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+        # single-worker short-circuit (reference does the same when
+        # size()==1): with |axes|==1 the collectives are no-ops but the
+        # bucket gather/scatter copies are not — skip them entirely.
+        world = 1
+        for ax in axes:
+            world *= jax.lax.psum(1, ax)
+        if world == 1:
+            return updates, state
         reduced = push_pull_tree(
             updates,
             plan=plan,

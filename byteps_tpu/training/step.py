@@ -1,0 +1,176 @@
+"""Train-step factories: the framework's hot path.
+
+The reference's hot path is loss.backward() firing per-gradient hooks that
+enqueue push_pull tasks drained by C++ threads (SURVEY.md §3.2).  The TPU
+rendering is one traced SPMD program per step: ``shard_map`` over the mesh,
+local backward, bucketed priority-ordered push_pull (collectives.py), optax
+update — XLA's latency-hiding scheduler overlaps the collective chain with
+the backward compute, which is precisely the role of the reference's
+10-thread pipeline (core_loops.cc).
+
+``make_data_parallel_step`` is the Horovod-benchmark-equivalent step used by
+bench.py and the examples; model-parallel (tp/sp) steps compose GSPMD jit
+with these same pieces (see models/transformer.py and __graft_entry__.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..common.config import get_config
+from ..common.partition import plan_buckets
+from ..ops.compression import Compression
+from .optimizer import DistributedOptimizer
+from ..parallel.collectives import shard_map
+
+
+class TrainState(NamedTuple):
+    """Functional train state (params + optimizer state + mutable model
+    collections such as BatchNorm running stats + step counter)."""
+
+    params: Any
+    opt_state: Any
+    model_state: Any
+    step: jax.Array
+
+
+def create_train_state(
+    params, tx: optax.GradientTransformation, model_state=None
+) -> TrainState:
+    return TrainState(
+        params=params,
+        opt_state=tx.init(params),
+        model_state=model_state if model_state is not None else {},
+        step=jnp.zeros((), jnp.int32),
+    )
+
+
+def make_data_parallel_step(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    axes: Sequence[str] = ("dp",),
+    compression: type = Compression.none,
+    partition_bytes: Optional[int] = None,
+    backward_passes_per_step: int = 1,
+    donate: bool = True,
+):
+    """Build a jitted data-parallel train step.
+
+    ``loss_fn(params, model_state, batch) -> (loss, new_model_state)`` runs
+    on the *local* batch shard.  The returned step function has signature
+    ``step(state: TrainState, batch) -> (TrainState, metrics)`` where
+    ``batch`` is a pytree whose leaves have the global batch on dim 0
+    (sharded over ``axes``), and metrics = {"loss": mean loss}.
+
+    Semantics match the reference benchmark
+    (example/pytorch/benchmark_byteps.py): gradients are *averaged* across
+    all workers via the bucketed scheduled push_pull; BatchNorm normalizes
+    per-replica (torchvision semantics) while running stats are averaged
+    across replicas so the state stays replicated.
+    """
+    axes = tuple(axes)
+    tx = DistributedOptimizer(
+        optimizer,
+        compression=compression,
+        axis_name=axes,
+        average=True,
+        partition_bytes=partition_bytes or get_config().partition_bytes,
+        backward_passes_per_step=backward_passes_per_step,
+    )
+
+    def local_step(state: TrainState, batch):
+        def lf(p):
+            return loss_fn(p, state.model_state, batch)
+
+        (loss, new_mstate), grads = jax.value_and_grad(lf, has_aux=True)(state.params)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        n = jax.lax.psum(1, axes)
+        loss = jax.lax.psum(loss, axes) / n
+        # keep mutable model state (BN stats) replicated: average across dp
+        new_mstate = jax.tree_util.tree_map(
+            lambda x: jax.lax.psum(x, axes) / n
+            if jnp.issubdtype(x.dtype, jnp.floating) else x,
+            new_mstate,
+        )
+        return (
+            TrainState(new_params, new_opt, new_mstate, state.step + 1),
+            {"loss": loss},
+        )
+
+    state_spec = P()  # params/opt state replicated across data axes
+    batch_spec = P(axes)
+    mapped = shard_map(
+        local_step,
+        mesh,
+        in_specs=(state_spec, batch_spec),
+        out_specs=(state_spec, state_spec),
+    )
+    jitted = jax.jit(mapped, donate_argnums=(0,) if donate else ())
+    return TrainStep(jitted, tx, mesh)
+
+
+class TrainStep:
+    """Callable train step bundling the jitted SPMD program with the
+    *wrapped* optimizer (DistributedOptimizer chain) whose state layout the
+    program expects — use ``init_state`` to build a matching TrainState."""
+
+    def __init__(self, fn, tx: optax.GradientTransformation, mesh: Mesh):
+        self._fn = fn
+        self.tx = tx
+        self.mesh = mesh
+
+    def __call__(self, state, batch):
+        return self._fn(state, batch)
+
+    def init_state(self, params, model_state=None) -> TrainState:
+        state = create_train_state(params, self.tx, model_state=model_state)
+        return replicate_state(state, self.mesh)
+
+    def lower(self, state, batch):
+        return self._fn.lower(state, batch)
+
+
+def shard_batch(batch, mesh: Mesh, axes: Sequence[str] = ("dp",)):
+    """Place a host batch on the mesh, dim 0 sharded over ``axes``."""
+    sharding = NamedSharding(mesh, P(tuple(axes)))
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, sharding), batch
+    )
+
+
+def replicate_state(state, mesh: Mesh):
+    sharding = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), state)
+
+
+def classification_loss_fn(model, train: bool = True, rngs_fn=None):
+    """Standard softmax-CE loss closure for a flax vision model with
+    (optional) BatchNorm state; fits ``make_data_parallel_step``."""
+
+    def loss_fn(params, model_state, batch):
+        images, labels = batch["image"], batch["label"]
+        variables = {"params": params, **model_state}
+        mutable = list(model_state.keys())
+        kwargs = {}
+        if rngs_fn is not None:
+            kwargs["rngs"] = rngs_fn()
+        if mutable:
+            logits, new_state = model.apply(
+                variables, images, train=train, mutable=mutable, **kwargs
+            )
+        else:
+            logits = model.apply(variables, images, train=train, **kwargs)
+            new_state = {}
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        ).mean()
+        return loss, new_state
+
+    return loss_fn

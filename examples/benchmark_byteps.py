@@ -1,0 +1,134 @@
+"""Synthetic training benchmark — the byteps_tpu rendering of
+``example/pytorch/benchmark_byteps.py`` (the reference's de-facto perf
+regression suite, SURVEY.md §4).
+
+Trains a model on synthetic data and reports images (or tokens) per second::
+
+    python examples/benchmark_byteps.py --model resnet50 --batch-size 64
+    python examples/benchmark_byteps.py --model vgg16 --num-iters 20
+    python examples/benchmark_byteps.py --model transformer --seq-len 1024
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import byteps_tpu as bps
+from byteps_tpu.models import ResNet50, VGG16, Transformer, TransformerConfig
+from byteps_tpu.training import (
+    classification_loss_fn,
+    make_data_parallel_step,
+    shard_batch,
+)
+
+
+def build_vision(args, mesh):
+    cls = ResNet50 if args.model == "resnet50" else VGG16
+    dtype = jnp.bfloat16 if args.bf16 else jnp.float32
+    model = cls(num_classes=1000, dtype=dtype)
+    x0 = jnp.zeros((args.batch_size, args.image_size, args.image_size, 3))
+    variables = model.init(jax.random.PRNGKey(0), x0, train=False)
+    tx = optax.sgd(0.01, momentum=0.9)
+    # VGG has dropout: benchmark with train=False-style determinism by
+    # seeding rngs per step would break jit caching; use a fixed fold-in
+    rngs_fn = (lambda: {"dropout": jax.random.PRNGKey(0)}) \
+        if args.model == "vgg16" else None
+    loss_fn = classification_loss_fn(model, rngs_fn=rngs_fn)
+    step = make_data_parallel_step(
+        loss_fn, tx, mesh, partition_bytes=args.partition_bytes
+    )
+    model_state = {k: v for k, v in variables.items() if k != "params"}
+    state = step.init_state(variables["params"], model_state=model_state)
+    n = args.batch_size * bps.size()
+    batch = shard_batch(
+        {
+            "image": jax.random.normal(
+                jax.random.PRNGKey(1), (n, args.image_size, args.image_size, 3)
+            ),
+            "label": jax.random.randint(jax.random.PRNGKey(2), (n,), 0, 1000),
+        },
+        mesh,
+    )
+    return step, state, batch, n
+
+
+def build_transformer(args, mesh):
+    cfg = TransformerConfig(
+        vocab_size=32000, num_layers=12, num_heads=12, d_model=768,
+        d_ff=3072, max_seq_len=args.seq_len,
+        dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+    )
+    model = Transformer(cfg)
+    tokens0 = jnp.zeros((args.batch_size, args.seq_len), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), tokens0)
+
+    def loss_fn(params, model_state, batch):
+        logits = model.apply({"params": params}, batch["tokens"])
+        targets = jnp.roll(batch["tokens"], -1, axis=1)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], targets[:, :-1]
+        ).mean()
+        return loss, model_state
+
+    tx = optax.adamw(1e-4)
+    step = make_data_parallel_step(
+        loss_fn, tx, mesh, partition_bytes=args.partition_bytes
+    )
+    import flax.linen as nn
+
+    state = step.init_state(nn.meta.unbox(variables["params"]))
+    n = args.batch_size * bps.size()
+    batch = shard_batch(
+        {"tokens": jax.random.randint(
+            jax.random.PRNGKey(1), (n, args.seq_len), 0, 32000)},
+        mesh,
+    )
+    return step, state, batch, n
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="resnet50",
+                   choices=["resnet50", "vgg16", "transformer"])
+    p.add_argument("--batch-size", type=int, default=64,
+                   help="per-worker batch (reference uses 64/GPU)")
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--seq-len", type=int, default=1024)
+    p.add_argument("--num-warmup", type=int, default=5)
+    p.add_argument("--num-iters", type=int, default=30)
+    p.add_argument("--bf16", action="store_true")
+    p.add_argument("--partition-bytes", type=int, default=4_096_000)
+    args = p.parse_args()
+
+    bps.init()
+    mesh = bps.mesh()
+    print(f"model={args.model} workers={bps.size()} mesh={dict(mesh.shape)}")
+
+    build = build_transformer if args.model == "transformer" else build_vision
+    step, state, batch, global_batch = build(args, mesh)
+
+    for _ in range(args.num_warmup):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics)
+
+    t0 = time.perf_counter()
+    for _ in range(args.num_iters):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics)
+    dt = (time.perf_counter() - t0) / args.num_iters
+
+    unit = "tokens" if args.model == "transformer" else "images"
+    scale = args.seq_len if args.model == "transformer" else 1
+    print(f"{args.model}: {global_batch * scale / dt:.1f} {unit}/sec "
+          f"({dt * 1000:.2f} ms/step, loss {float(metrics['loss']):.4f})")
+    bps.shutdown()
+
+
+if __name__ == "__main__":
+    main()

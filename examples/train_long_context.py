@@ -1,0 +1,96 @@
+"""Long-context training with sequence/context parallelism — the capability
+the reference lacks entirely (SURVEY.md §5 "Long-context: Absent") and the
+TPU rebuild treats as first-class: the sequence dim is sharded over an
+``sp`` mesh axis and attention runs as ring attention (``lax.ppermute`` K/V
+rotation over ICI neighbors; parallel/ring_attention.py).
+
+Run (single host, virtual devices)::
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/train_long_context.py --seq-len 2048 --sp 4 --dp 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from byteps_tpu.models import Transformer, TransformerConfig
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq-len", type=int, default=2048)
+    p.add_argument("--batch-size", type=int, default=4, help="global batch")
+    p.add_argument("--dp", type=int, default=0, help="0 = infer")
+    p.add_argument("--sp", type=int, default=0, help="0 = infer")
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--d-model", type=int, default=512)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--attn", default="ring", choices=["ring", "ulysses"])
+    args = p.parse_args()
+
+    n = len(jax.devices())
+    sp = args.sp or (n if args.dp == 0 else n // args.dp)
+    dp = args.dp or n // sp
+    assert dp * sp == n, f"dp*sp must equal device count {n}"
+    mesh = Mesh(np.array(jax.devices()).reshape(dp, sp), ("dp", "sp"))
+    print(f"mesh: dp={dp} sp={sp} attn={args.attn} T={args.seq_len}")
+
+    cfg = TransformerConfig(
+        vocab_size=8192, num_layers=args.layers, num_heads=args.heads,
+        d_model=args.d_model, d_ff=args.d_model * 4,
+        max_seq_len=args.seq_len, dtype=jnp.bfloat16,
+        attn_impl=args.attn, mesh=mesh,
+    )
+    model = Transformer(cfg)
+    tokens0 = jnp.zeros((args.batch_size, args.seq_len), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), tokens0)
+    params = nn.meta.unbox(variables["params"])
+    params = jax.device_put(params, NamedSharding(mesh, P()))
+    tx = optax.adamw(3e-4)
+    opt_state = jax.device_put(tx.init(params), NamedSharding(mesh, P()))
+
+    tok_sharding = NamedSharding(mesh, P("dp", "sp"))
+
+    def train_step(params, opt_state, tokens):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, tokens)
+            targets = jnp.roll(tokens, -1, axis=1)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], targets[:, :-1]
+            ).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+    tokens = jax.device_put(
+        jax.random.randint(
+            jax.random.PRNGKey(1), (args.batch_size, args.seq_len), 0, 8192
+        ),
+        tok_sharding,
+    )
+
+    params, opt_state, loss = step(params, opt_state, tokens)  # compile
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        print(f"step {i} loss {float(loss):.4f}")
+    dt = (time.perf_counter() - t0) / args.steps
+    toks = args.batch_size * args.seq_len
+    print(f"{toks / dt:.0f} tokens/sec ({dt * 1000:.1f} ms/step)")
+
+
+if __name__ == "__main__":
+    main()

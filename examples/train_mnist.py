@@ -1,0 +1,92 @@
+"""Data-parallel MNIST-style training — the byteps_tpu rendering of the
+reference's ``example/pytorch/train_mnist_byteps.py`` (the minimum
+end-to-end slice of SURVEY.md §7 step 3).
+
+Uses synthetic MNIST-shaped data (this image has no dataset egress); swap in
+real data by replacing ``synthetic_mnist``.  Run::
+
+    python examples/train_mnist.py [--steps 200] [--batch-size 512]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import byteps_tpu as bps
+from byteps_tpu.training import make_data_parallel_step, shard_batch
+from byteps_tpu.training.callbacks import warmup_schedule
+
+
+def synthetic_mnist(key, n=8192):
+    """Class-conditional Gaussian blobs, 28x28x1, 10 classes."""
+    kx, ky = jax.random.split(key)
+    labels = jax.random.randint(ky, (n,), 0, 10)
+    centers = jax.random.normal(kx, (10, 28, 28, 1)) * 0.5
+    images = centers[labels] + jax.random.normal(kx, (n, 28, 28, 1)) * 0.3
+    return images, labels
+
+
+def mlp_loss_fn(params, model_state, batch):
+    x = batch["image"].reshape(batch["image"].shape[0], -1)
+    h = jax.nn.relu(x @ params["w1"] + params["b1"])
+    logits = h @ params["w2"] + params["b2"]
+    loss = optax.softmax_cross_entropy_with_integer_labels(
+        logits, batch["label"]
+    ).mean()
+    return loss, model_state
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--batch-size", type=int, default=512)
+    p.add_argument("--lr", type=float, default=0.01)
+    args = p.parse_args()
+
+    bps.init()
+    mesh = bps.mesh()
+    print(f"workers={bps.size()} mesh={dict(mesh.shape)}")
+
+    key = jax.random.PRNGKey(0)
+    k1, k2 = jax.random.split(key)
+    params = {
+        "w1": jax.random.normal(k1, (784, 256)) * 0.05,
+        "b1": jnp.zeros(256),
+        "w2": jax.random.normal(k2, (256, 10)) * 0.05,
+        "b2": jnp.zeros(10),
+    }
+    # consistent init across workers (reference broadcast_parameters)
+    params = bps.broadcast_parameters(params, root_rank=0)
+
+    sched = warmup_schedule(args.lr, bps.size(), warmup_steps=50)
+    tx = optax.sgd(sched, momentum=0.9)
+    step = make_data_parallel_step(mlp_loss_fn, tx, mesh)
+    state = step.init_state(params)
+
+    images, labels = synthetic_mnist(jax.random.PRNGKey(1))
+    n = images.shape[0]
+    t0 = time.time()
+    for i in range(args.steps):
+        idx = jax.random.randint(
+            jax.random.PRNGKey(i), (args.batch_size,), 0, n
+        )
+        batch = shard_batch(
+            {"image": images[idx], "label": labels[idx]}, mesh
+        )
+        state, metrics = step(state, batch)
+        if i % 50 == 0 or i == args.steps - 1:
+            print(f"step {i:4d} loss {float(metrics['loss']):.4f}")
+    dt = time.time() - t0
+    print(f"done: {args.steps} steps in {dt:.1f}s "
+          f"({args.steps * args.batch_size / dt:.0f} samples/s)")
+    bps.shutdown()
+
+
+if __name__ == "__main__":
+    main()

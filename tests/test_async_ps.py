@@ -1,0 +1,132 @@
+"""Async parameter-server mode tests.
+
+Staleness contract (reference BYTEPS_ENABLE_ASYNC semantics,
+torch/__init__.py:174-189): global state == initial + sum of all pushed
+deltas; read-your-writes per worker; no barrier — interleaving order doesn't
+change the final state (summation is commutative).
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from byteps_tpu.engine.async_ps import AsyncParameterServer, AsyncWorker
+
+
+def test_push_pull_accumulates_deltas():
+    server = AsyncParameterServer(use_native=False)
+    p0 = {"w": np.zeros(4, np.float32)}
+    w1 = AsyncWorker(server, p0, worker_id=0)
+    w2 = AsyncWorker(server, p0, worker_id=1)
+
+    w1.push_pull({"w": np.ones(4, np.float32)})  # delta +1
+    got = w2.push_pull({"w": np.full(4, 2.0, np.float32)})  # delta +2
+    np.testing.assert_allclose(got["w"], np.full(4, 3.0))  # 0 + 1 + 2
+
+
+def test_read_your_writes():
+    server = AsyncParameterServer(use_native=False)
+    w = AsyncWorker(server, {"w": np.zeros(2, np.float32)})
+    out = w.push_pull({"w": np.array([1.0, -1.0], np.float32)})
+    np.testing.assert_allclose(out["w"], [1.0, -1.0])
+    # second push is a delta vs the pulled snapshot, not vs initial
+    out = w.push_pull({"w": np.array([2.0, 0.0], np.float32)})
+    np.testing.assert_allclose(out["w"], [2.0, 0.0])
+
+
+def test_interleaving_order_is_commutative():
+    def run(order):
+        server = AsyncParameterServer(use_native=False)
+        p0 = {"w": np.zeros(1, np.float32)}
+        workers = [AsyncWorker(server, p0, worker_id=i) for i in range(3)]
+        deltas = [1.0, 10.0, 100.0]
+        for i in order:
+            snap = workers[i]._snapshot[0]
+            workers[i].push_pull({"w": snap + deltas[i]})
+        return server.pull("param_0")
+
+    a = run([0, 1, 2])
+    b = run([2, 0, 1])
+    np.testing.assert_allclose(a, b)
+    np.testing.assert_allclose(a, [111.0])
+
+
+def test_concurrent_workers_no_lost_updates():
+    server = AsyncParameterServer(use_native=False)
+    p0 = {"w": np.zeros(8, np.float32)}
+    nworkers, nsteps = 4, 25
+    workers = [AsyncWorker(server, p0, worker_id=i) for i in range(nworkers)]
+
+    def work(w):
+        for _ in range(nsteps):
+            w.push_pull({"w": w._snapshot[0] + 1.0})
+
+    threads = [threading.Thread(target=work, args=(w,)) for w in workers]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    np.testing.assert_allclose(server.pull("param_0"),
+                               np.full(8, nworkers * nsteps, np.float32))
+
+
+def test_async_training_converges():
+    """Two async workers minimizing the same quadratic reach the optimum
+    despite stale pulls (the reference's convergence claim for async mode)."""
+    server = AsyncParameterServer(use_native=False)
+    target = np.array([3.0, -2.0], np.float32)
+    p0 = {"w": np.zeros(2, np.float32)}
+    workers = [AsyncWorker(server, p0, worker_id=i) for i in range(2)]
+    lr = 0.2
+
+    for _ in range(60):
+        for w in workers:
+            cur = w.params["w"]
+            grad = cur - target  # d/dw 0.5*(w-t)^2
+            w.push_pull({"w": cur - lr * grad})
+    for w in workers:
+        np.testing.assert_allclose(w.params["w"], target, atol=1e-2)
+
+
+def test_native_reducer_matches_numpy():
+    from byteps_tpu.native import reducer
+
+    if not reducer.available():
+        pytest.skip("native lib unavailable")
+    rng = np.random.default_rng(0)
+    for dtype, atol in [(np.float32, 1e-6), (np.float16, 2e-3),
+                        (np.int32, 0), (np.int64, 0), (np.float64, 1e-12)]:
+        if np.issubdtype(dtype, np.floating):
+            a = rng.standard_normal(1027).astype(dtype)
+            b = rng.standard_normal(1027).astype(dtype)
+        else:
+            a = rng.integers(-1000, 1000, 1027).astype(dtype)
+            b = rng.integers(-1000, 1000, 1027).astype(dtype)
+        expect = (a.astype(np.float64) + b.astype(np.float64)) if atol else a + b
+        got = a.copy()
+        reducer.sum_into(got, b)
+        if atol:
+            np.testing.assert_allclose(got.astype(np.float64), expect,
+                                       atol=atol, rtol=1e-2)
+        else:
+            np.testing.assert_array_equal(got, expect)
+
+
+def test_native_key_to_shard_matches_reference_formula():
+    from byteps_tpu.native import reducer
+
+    for key in [0, 1, 65535, 65536, 2**31, 123456789]:
+        for n in [1, 3, 7, 32]:
+            expect = (((key >> 16) + (key % 65536)) * 9973) % n
+            assert reducer.key_to_shard(key, n) == expect
+
+
+def test_server_with_native_reducer():
+    server = AsyncParameterServer(use_native=True)
+    w = AsyncWorker(server, {"w": np.zeros(1000, np.float32)})
+    out = w.push_pull({"w": np.ones(1000, np.float32)})
+    np.testing.assert_allclose(out["w"], 1.0)

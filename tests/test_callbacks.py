@@ -1,0 +1,68 @@
+"""Callback/schedule tests (reference _keras/callbacks.py behaviors)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from byteps_tpu.training.callbacks import (
+    momentum_corrected_sgd,
+    multiplier_schedule,
+    scaled_lr,
+    warmup_schedule,
+)
+
+
+def test_warmup_ramps_to_scaled_lr():
+    sched = warmup_schedule(base_lr=0.1, world_size=8, warmup_steps=10)
+    np.testing.assert_allclose(float(sched(0)), 0.1, rtol=1e-6)
+    np.testing.assert_allclose(float(sched(10)), 0.8, rtol=1e-6)
+    np.testing.assert_allclose(float(sched(5)), 0.1 + 0.7 * 0.5, rtol=1e-6)
+    # holds at peak after warmup
+    np.testing.assert_allclose(float(sched(100)), 0.8, rtol=1e-6)
+
+
+def test_warmup_hands_off_to_after_schedule():
+    after = optax.constant_schedule(0.01)
+    sched = warmup_schedule(0.1, 4, 10, after=after)
+    np.testing.assert_allclose(float(sched(20)), 0.01, rtol=1e-6)
+
+
+def test_multiplier_schedule_staircase():
+    sched = multiplier_schedule(0.4, {30: 0.1, 60: 0.01})
+    np.testing.assert_allclose(float(sched(0)), 0.4, rtol=1e-6)
+    np.testing.assert_allclose(float(sched(30)), 0.04, rtol=1e-6)
+    np.testing.assert_allclose(float(sched(61)), 0.004, rtol=1e-6)
+
+
+def test_momentum_correction_rescales_velocity():
+    """After an LR change the velocity is scaled by lr1/lr0 (reference
+    _keras/callbacks.py:143-171)."""
+    lrs = {0: 1.0}  # base 0.1, drops 10x at step 2
+    sched = multiplier_schedule(0.1, {2: 0.1})
+    tx = momentum_corrected_sgd(sched, momentum=0.9)
+    params = {"w": jnp.zeros(1)}
+    state = tx.init(params)
+    g = {"w": jnp.ones(1)}
+
+    # step 0: lr=0.1, trace=1, update=-0.1
+    up, state = tx.update(g, state)
+    np.testing.assert_allclose(np.asarray(up["w"]), [-0.1], rtol=1e-6)
+    # step 1: lr=0.1, trace=0.9*1*1 + 1=1.9
+    up, state = tx.update(g, state)
+    np.testing.assert_allclose(np.asarray(up["w"]), [-0.19], rtol=1e-6)
+    # step 2: lr drops to 0.01 -> correction 0.1: trace=0.9*1.9*0.1+1=1.171
+    up, state = tx.update(g, state)
+    np.testing.assert_allclose(np.asarray(up["w"]), [-0.01171], rtol=1e-5)
+
+
+def test_momentum_corrected_sgd_trains():
+    sched = warmup_schedule(0.05, 2, 5)
+    tx = momentum_corrected_sgd(sched, momentum=0.9)
+    params = jnp.array([5.0])
+    state = tx.init(params)
+    for _ in range(200):
+        grads = params  # minimize 0.5*x^2
+        updates, state = tx.update(grads, state)
+        params = optax.apply_updates(params, updates)
+    assert abs(float(params[0])) < 0.1

@@ -1,0 +1,50 @@
+"""Launcher env-contract tests (reference launcher/launch.py:10-64)."""
+
+import subprocess
+import sys
+
+import pytest
+
+from byteps_tpu.launcher import build_child_env, main
+
+
+def test_build_child_env_single_worker():
+    env = {"DMLC_ROLE": "worker", "DMLC_NUM_WORKER": "1"}
+    child = build_child_env(env)
+    assert child["BYTEPS_LOCAL_RANK"] == "0"
+    assert "BYTEPS_DISTRIBUTED_INIT" not in child
+
+
+def test_build_child_env_multi_worker():
+    env = {
+        "DMLC_ROLE": "worker",
+        "DMLC_NUM_WORKER": "4",
+        "DMLC_WORKER_ID": "2",
+        "DMLC_PS_ROOT_URI": "10.0.0.1",
+        "DMLC_PS_ROOT_PORT": "9000",
+    }
+    child = build_child_env(env)
+    assert child["BYTEPS_COORDINATOR_ADDR"] == "10.0.0.1:9000"
+    assert child["BYTEPS_NUM_PROCESSES"] == "4"
+    assert child["BYTEPS_PROCESS_ID"] == "2"
+    assert child["BYTEPS_DISTRIBUTED_INIT"] == "1"
+
+
+def test_server_role_exits_cleanly(monkeypatch):
+    monkeypatch.setenv("DMLC_ROLE", "server")
+    assert main(["python", "-c", "pass"]) == 0
+
+
+def test_missing_env_raises(monkeypatch):
+    monkeypatch.setenv("DMLC_ROLE", "worker")
+    monkeypatch.setenv("DMLC_NUM_WORKER", "2")
+    for k in ("DMLC_WORKER_ID", "DMLC_PS_ROOT_URI", "DMLC_PS_ROOT_PORT"):
+        monkeypatch.delenv(k, raising=False)
+    with pytest.raises(SystemExit):
+        main(["python", "-c", "pass"])
+
+
+def test_launcher_runs_command(monkeypatch):
+    monkeypatch.setenv("DMLC_ROLE", "worker")
+    monkeypatch.setenv("DMLC_NUM_WORKER", "1")
+    assert main([sys.executable, "-c", "import os; assert os.environ['BYTEPS_LOCAL_RANK'] == '0'"]) == 0

@@ -1,0 +1,64 @@
+"""Tracing subsystem tests (reference docs/timeline.md behavior)."""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+import byteps_tpu as bps
+from byteps_tpu.common.config import Config, set_config
+from byteps_tpu.common import tracing
+
+
+def test_tracer_records_spans(tmp_path):
+    t = tracing.Tracer(path=str(tmp_path / "trace.json"))
+    with t.span("Gradient_w", "push_pull", key=7, bytes=128):
+        pass
+    t.instant("start", "engine")
+    path = t.flush()
+    data = json.load(open(path))
+    evs = data["traceEvents"]
+    assert len(evs) == 2
+    span = [e for e in evs if e["ph"] == "X"][0]
+    assert span["name"] == "Gradient_w"
+    assert span["args"]["key"] == 7
+    assert span["dur"] >= 0
+
+
+def test_tracer_key_filter():
+    t = tracing.Tracer(path="unused.json", key_filter="Gradient")
+    with t.span("Parameter_b", "push_pull"):
+        pass
+    with t.span("Gradient_w", "push_pull"):
+        pass
+    assert [e["name"] for e in t.events()] == ["Gradient_w"]
+
+
+def test_disabled_tracer_is_noop():
+    t = tracing.Tracer(path="")
+    with t.span("x", "s"):
+        pass
+    assert t.events() == []
+    assert t.flush() is None
+
+
+def test_engine_emits_trace(tmp_path):
+    trace_file = str(tmp_path / "bps_trace.json")
+    cfg = Config.from_env()
+    cfg.trace_path = trace_file
+    set_config(cfg)
+    tracing.reset_tracer()
+
+    bps.init()
+    n = bps.size()
+    x = jnp.ones((n, 4), jnp.float32)
+    out = bps.push_pull(x, average=False, name="traced_tensor")
+    np.testing.assert_allclose(np.asarray(out), n)
+    bps.shutdown()  # flushes
+
+    data = json.load(open(trace_file))
+    names = {e["name"] for e in data["traceEvents"]}
+    assert any("traced_tensor" in s for s in names)
+    stages = {e["tid"] for e in data["traceEvents"]}
+    assert {"dispatch", "push_pull"} <= stages

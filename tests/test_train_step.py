@@ -1,0 +1,158 @@
+"""End-to-end data-parallel training tests on the 8-device CPU mesh.
+
+Behavioral contracts from the reference's tests (SURVEY.md §4): training
+loss decreases (non-hanging, converging loop — test_tensorflow_keras.py),
+and the data-parallel step equals a single-device step on the concatenated
+batch (sum/average correctness — test_mxnet.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh
+
+import byteps_tpu as bps
+from byteps_tpu.models import ResNet18
+from byteps_tpu.training import (
+    classification_loss_fn,
+    create_train_state,
+    make_data_parallel_step,
+    replicate_state,
+    shard_batch,
+)
+
+
+def _mesh(n=8):
+    return Mesh(np.array(jax.devices()[:n]), ("dp",))
+
+
+def _mlp_loss_fn(params, model_state, batch):
+    x, y = batch["image"], batch["label"]
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    logits = h @ params["w2"] + params["b2"]
+    loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+    return loss, model_state
+
+
+def _mlp_params(key, din=8, dh=16, dout=4):
+    k1, k2 = jax.random.split(key)
+    return {
+        "w1": jax.random.normal(k1, (din, dh)) * 0.1,
+        "b1": jnp.zeros((dh,)),
+        "w2": jax.random.normal(k2, (dh, dout)) * 0.1,
+        "b2": jnp.zeros((dout,)),
+    }
+
+
+def test_dp_step_matches_single_device():
+    """8-way data-parallel step == single-device step on the full batch."""
+    mesh = _mesh()
+    key = jax.random.PRNGKey(0)
+    params = _mlp_params(key)
+    tx = optax.sgd(0.1)
+
+    batch = {
+        "image": jax.random.normal(jax.random.PRNGKey(1), (16, 8)),
+        "label": jax.random.randint(jax.random.PRNGKey(2), (16,), 0, 4),
+    }
+
+    # single-device reference: plain sgd on the full batch
+    def ref_step(params, batch):
+        loss, grads = jax.value_and_grad(
+            lambda p: _mlp_loss_fn(p, {}, batch)[0]
+        )(params)
+        return jax.tree_util.tree_map(lambda p, g: p - 0.1 * g, params, grads), loss
+
+    ref_params, ref_loss = ref_step(params, batch)
+
+    step = make_data_parallel_step(_mlp_loss_fn, tx, mesh, donate=False)
+    state = step.init_state(params)
+    new_state, metrics = step(state, shard_batch(batch, mesh))
+
+    np.testing.assert_allclose(float(metrics["loss"]), float(ref_loss), atol=1e-5)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(new_state.params),
+        jax.tree_util.tree_leaves(ref_params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+    assert int(new_state.step) == 1
+
+
+def test_dp_training_loss_decreases():
+    mesh = _mesh()
+    params = _mlp_params(jax.random.PRNGKey(0))
+    tx = optax.sgd(0.5)
+    step = make_data_parallel_step(_mlp_loss_fn, tx, mesh)
+    state = step.init_state(params)
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (32, 8))
+    y = (x.sum(-1) > 0).astype(jnp.int32)
+    batch = shard_batch({"image": x, "label": y}, mesh)
+
+    losses = []
+    for _ in range(20):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_resnet_dp_step_runs():
+    """Full flax ResNet with BatchNorm state through the dp step."""
+    mesh = _mesh()
+    model = ResNet18(num_classes=4, num_filters=8)
+    x = jnp.zeros((8, 16, 16, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    params = variables["params"]
+    model_state = {"batch_stats": variables["batch_stats"]}
+
+    tx = optax.sgd(0.01, momentum=0.9)
+    loss_fn = classification_loss_fn(model)
+    step = make_data_parallel_step(loss_fn, tx, mesh)
+    state = step.init_state(params, model_state=model_state)
+    batch = shard_batch(
+        {
+            "image": jax.random.normal(jax.random.PRNGKey(1), (8, 16, 16, 3)),
+            "label": jax.random.randint(jax.random.PRNGKey(2), (8,), 0, 4),
+        },
+        mesh,
+    )
+    state, metrics = step(state, batch)
+    assert np.isfinite(metrics["loss"])
+    state, metrics2 = step(state, batch)
+    assert np.isfinite(metrics2["loss"])
+    assert int(state.step) == 2
+
+
+def test_backward_passes_per_step_accumulates():
+    """backward_passes_per_step=k: params only move every k-th call
+    (reference torch/__init__.py:107-154)."""
+    mesh = _mesh()
+    params = _mlp_params(jax.random.PRNGKey(0))
+    tx = optax.sgd(0.1)
+    step = make_data_parallel_step(
+        _mlp_loss_fn, tx, mesh, backward_passes_per_step=2, donate=False
+    )
+    state = step.init_state(params)
+    batch = shard_batch(
+        {
+            "image": jax.random.normal(jax.random.PRNGKey(1), (16, 8)),
+            "label": jax.random.randint(jax.random.PRNGKey(2), (16,), 0, 4),
+        },
+        mesh,
+    )
+    s1, _ = step(state, batch)
+    # after 1 of 2 passes params unchanged
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s1.params), jax.tree_util.tree_leaves(params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-7)
+    s2, _ = step(s1, batch)
+    moved = any(
+        not np.allclose(np.asarray(a), np.asarray(b))
+        for a, b in zip(
+            jax.tree_util.tree_leaves(s2.params),
+            jax.tree_util.tree_leaves(params),
+        )
+    )
+    assert moved
