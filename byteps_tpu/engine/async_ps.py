@@ -68,7 +68,9 @@ class AsyncParameterServer:
                 self._version[name] = 0
 
     def _accumulate(self, dst: np.ndarray, delta: np.ndarray) -> None:
-        if self._reducer is not None and dst.dtype in (np.float32, np.float16):
+        if self._reducer is not None:
+            # sum_into dispatches per dtype (fp32/64/16/bf16/int) and falls
+            # back to numpy itself for anything unsupported
             self._reducer.sum_into(dst, delta)
         else:
             dst += delta

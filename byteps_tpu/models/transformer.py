@@ -78,20 +78,12 @@ class TransformerConfig:
             None,
         )
 
-        fn = partial(inner, axis_name=self.sp_axis, causal=True)
-        try:  # jax >= 0.6
-            smap = partial(
-                jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
-                out_specs=spec, check_vma=False,
-            )
-        except Exception:  # pragma: no cover
-            from jax.experimental.shard_map import shard_map as _sm
+        from ..parallel.collectives import shard_map
 
-            smap = partial(
-                _sm, mesh=mesh, in_specs=(spec, spec, spec),
-                out_specs=spec, check_rep=False,
-            )
-        return smap(fn)
+        fn = partial(inner, axis_name=self.sp_axis, causal=True)
+        return shard_map(
+            fn, mesh, in_specs=(spec, spec, spec), out_specs=spec
+        )
 
 
 class Attention(nn.Module):

@@ -92,6 +92,12 @@ _SUM_FN = {
     np.dtype(np.int32): "bps_sum_i32",
     np.dtype(np.int64): "bps_sum_i64",
 }
+try:
+    import ml_dtypes
+
+    _SUM_FN[np.dtype(ml_dtypes.bfloat16)] = "bps_sum_bf16"
+except ImportError:  # pragma: no cover
+    pass
 
 
 def sum_into(dst: np.ndarray, src: np.ndarray) -> None:

@@ -13,6 +13,8 @@ from .collectives import (
     shard_map,
 )
 from .ring_attention import local_attention, ring_attention, ulysses_attention
+from .pipeline import pipeline_apply, pipeline_loss
+from .moe import load_balancing_loss, moe_ffn, top1_routing
 
 __all__ = [
     "AXIS_ORDER", "build_mesh", "parse_mesh_shape", "reduce_axes",
@@ -20,4 +22,6 @@ __all__ = [
     "push_pull_shard", "push_pull_tree", "push_pull_stacked",
     "broadcast_shard", "broadcast_stacked", "replicate", "shard_map",
     "ring_attention", "ulysses_attention", "local_attention",
+    "pipeline_apply", "pipeline_loss",
+    "moe_ffn", "top1_routing", "load_balancing_loss",
 ]

@@ -1,0 +1,113 @@
+"""Expert parallelism (ep) — Switch-style top-1 routed MoE FFN with
+``all_to_all`` dispatch over a mesh axis.
+
+Absent from the reference (SURVEY.md §2.4); supplied as the TPU-idiomatic
+"ep" axis: experts are sharded over ``ep``, each rank routes its local
+tokens, buckets them per destination rank with static capacity (XLA needs
+static shapes — overflow tokens are *dropped*, the standard Switch
+Transformer behavior, and their outputs fall back to zero so the residual
+stream carries them), exchanges buckets with one ``all_to_all``, runs its
+local experts' FFN batched on the MXU, and returns results with a second
+``all_to_all``.
+
+Everything here is called inside ``shard_map``; weights for the local
+experts arrive pre-sharded (leading expert dim = local experts).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def top1_routing(
+    logits: jax.Array, capacity: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Switch top-1 router.
+
+    logits: ``[T, E]``.  Returns ``dispatch [T, E, C]`` (0/1) and
+    ``combine [T, E, C]`` (gate-prob weighted) tensors with per-expert
+    capacity ``C``; tokens beyond capacity are dropped.
+    """
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)  # [T]
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]  # [T]
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)  # [T, E]
+    # position of each token within its expert's queue (arrival order)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1  # [T, E]; -1 where not routed
+    keep = (pos >= 0) & (pos < capacity)
+    pos_oh = jax.nn.one_hot(jnp.clip(pos, 0, capacity - 1), capacity,
+                            dtype=jnp.float32)  # [T, E, C]
+    dispatch = pos_oh * keep[..., None].astype(jnp.float32)
+    combine = dispatch * gate[:, None, None]
+    return dispatch, combine
+
+
+def moe_ffn(
+    x: jax.Array,
+    gate_w: jax.Array,
+    w_up: jax.Array,
+    w_down: jax.Array,
+    axis_name: Optional[str] = "ep",
+    capacity_factor: float = 1.25,
+) -> jax.Array:
+    """Expert-parallel routed FFN.  Call inside shard_map.
+
+    x: ``[T, D]`` local tokens.  gate_w: ``[D, E_total]`` (replicated).
+    w_up: ``[E_local, D, F]``, w_down: ``[E_local, F, D]`` — this rank's
+    expert weights.  Returns ``[T, D]``.
+
+    With ``axis_name=None`` (or axis size 1) this is single-rank routed MoE:
+    all experts local, no all_to_all.
+    """
+    T, D = x.shape
+    n = lax.psum(1, axis_name) if axis_name is not None else 1
+    E_local = w_up.shape[0]
+    E = E_local * n
+    capacity = max(1, int(T * capacity_factor / E))
+
+    logits = x @ gate_w.astype(x.dtype)  # [T, E]
+    dispatch, combine = top1_routing(logits, capacity)  # [T, E, C]
+
+    xf = x.astype(jnp.float32)
+    # bucket tokens per expert: [E, C, D]
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, xf)
+    if n > 1:
+        # tiled all_to_all: block j of the split axis (rank j's experts) goes
+        # to rank j; received blocks concatenate along concat_axis.
+        # [E, C, D] -> [E_local, n*C, D], token-source-major along axis 1
+        expert_in = lax.all_to_all(
+            expert_in, axis_name, split_axis=0, concat_axis=1, tiled=True
+        )
+
+    h = jnp.einsum("ecd,edf->ecf", expert_in.astype(x.dtype),
+                   w_up, preferred_element_type=jnp.float32)
+    h = jax.nn.gelu(h)
+    out = jnp.einsum("ecf,efd->ecd", h.astype(x.dtype), w_down,
+                     preferred_element_type=jnp.float32)  # [E_local, nC, D]
+
+    if n > 1:
+        # inverse tiled exchange: [E_local, n*C, D] -> [E, C, D] (block i of
+        # axis 1 returns to source rank i; received blocks stack expert-major)
+        out = lax.all_to_all(out, axis_name, split_axis=1, concat_axis=0,
+                             tiled=True)
+    else:
+        out = out.reshape(E, capacity, D)
+
+    y = jnp.einsum("tec,ecd->td", combine, out)  # gate-weighted return
+    return y.astype(x.dtype)
+
+
+def load_balancing_loss(logits: jax.Array) -> jax.Array:
+    """Switch aux loss: E * sum_e (fraction routed to e * mean prob of e)."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    E = logits.shape[-1]
+    frac = jnp.mean(
+        jax.nn.one_hot(jnp.argmax(probs, -1), E, dtype=jnp.float32), axis=0
+    )
+    mean_prob = jnp.mean(probs, axis=0)
+    return E * jnp.sum(frac * mean_prob)

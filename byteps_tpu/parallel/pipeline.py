@@ -1,0 +1,116 @@
+"""Pipeline parallelism (pp) — GPipe-style microbatch pipelining over a mesh
+axis, expressed as program structure (``lax.scan`` + ``lax.ppermute``), not
+runtime threads.
+
+The reference has no pipeline parallelism (SURVEY.md §2.4 "Not present");
+this supplies the TPU-idiomatic version: every pp rank holds one *stage*'s
+parameters (stacked stage-major so ``shard_map`` gives each rank its own
+slice), activations hop one ICI neighbor per tick via ``ppermute``, and the
+scan runs ``n_micro + n_stages - 1`` ticks so the bubble is explicit.
+``jax.grad`` through the scan yields the GPipe backward schedule for free
+(reverse-mode ppermute is the reverse permutation); wrap ``stage_fn`` in
+``jax.checkpoint`` for the classic activation-rematerialized variant.
+
+Constraints (all XLA-friendly by design): stages must be homogeneous (same
+params pytree structure and same activation shape at every cut point) — the
+standard "repeated transformer block" regime.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    microbatches: jax.Array,
+    axis_name: str = "pp",
+    remat: bool = False,
+) -> jax.Array:
+    """Run ``microbatches`` through the pipeline; call inside ``shard_map``.
+
+    Args:
+      stage_fn: ``(params_for_one_stage, x) -> y`` with ``y.shape == x.shape``
+        (homogeneous cuts).
+      stage_params: this rank's stage parameters (under shard_map the caller
+        passes the stage-stacked tree with in_spec ``P('pp')``; each rank
+        sees its own slice with the leading stage axis of size 1 squeezed by
+        the caller, or kept — we accept either via tree_map squeeze).
+      microbatches: ``[n_micro, mb, ...]`` — the *global* microbatch stream,
+        replicated across pp ranks (only stage 0 reads it).
+      remat: rematerialize stage activations in backward (GPipe memory
+        behavior; jax.checkpoint).
+
+    Returns:
+      ``[n_micro, mb, ...]`` outputs, valid on the LAST stage (other ranks
+      return zeros — callers psum or read from the last rank).
+    """
+    n_stages = lax.psum(1, axis_name)
+    stage = lax.axis_index(axis_name)
+    n_micro = microbatches.shape[0]
+    total_ticks = n_micro + n_stages - 1
+
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    mb_shape = microbatches.shape[1:]
+    init_buf = jnp.zeros(mb_shape, microbatches.dtype)
+    outputs0 = jnp.zeros((n_micro,) + mb_shape, microbatches.dtype)
+
+    def tick(carry, t):
+        buf, outputs = carry
+        # stage 0 ingests microbatch t (clamped; beyond n_micro it's drain
+        # ticks where stage 0's output is garbage that never reaches the
+        # last stage before the scan ends)
+        mb_idx = jnp.minimum(t, n_micro - 1)
+        x = jnp.where(stage == 0, microbatches[mb_idx], buf)
+        y = fn(stage_params, x)
+        # the last stage completes microbatch (t - (n_stages - 1))
+        out_idx = t - (n_stages - 1)
+        valid = jnp.logical_and(stage == n_stages - 1, out_idx >= 0)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs,
+            jnp.where(valid, y, outputs[jnp.maximum(out_idx, 0)]),
+            jnp.maximum(out_idx, 0),
+            axis=0,
+        )
+        buf = lax.ppermute(y, axis_name, perm)
+        return (buf, outputs), None
+
+    (_, outputs), _ = lax.scan(tick, (init_buf, outputs0), jnp.arange(total_ticks))
+    return outputs
+
+
+def pipeline_loss(
+    stage_fn: Callable,
+    loss_fn: Callable[[jax.Array, Any], jax.Array],
+    stage_params: Any,
+    microbatches: jax.Array,
+    targets: Any,
+    axis_name: str = "pp",
+    remat: bool = False,
+) -> jax.Array:
+    """Mean loss over microbatches; valid (identical) on every pp rank.
+
+    ``loss_fn(final_activation_microbatch, target_microbatch) -> scalar``.
+    The last stage computes the loss; a psum shares it (each other rank
+    contributes 0), so ``jax.grad`` of this is well-defined on all ranks and
+    each rank's grads flow only to its own stage's params.
+    """
+    n_stages = lax.psum(1, axis_name)
+    stage = lax.axis_index(axis_name)
+    outs = pipeline_apply(stage_fn, stage_params, microbatches,
+                          axis_name=axis_name, remat=remat)
+
+    def per_micro(o, t):
+        return loss_fn(o, t)
+
+    losses = jax.vmap(per_micro)(outs, targets)
+    local = jnp.where(stage == n_stages - 1, losses.mean(), 0.0)
+    return lax.psum(local, axis_name)

@@ -1,0 +1,145 @@
+"""Checkpoint / resume.
+
+The reference delegates checkpointing to the frameworks and only supplies
+the *consistency* half: ``broadcast_parameters`` / ``broadcast_optimizer_state``
+so every worker resumes from the root's state (SURVEY.md §5
+"Checkpoint / resume"; torch/__init__.py:234-381, keras/callbacks.py:28-31).
+
+The TPU rebuild owns the whole story: orbax-backed save/restore of the
+functional TrainState plus the same broadcast-on-resume contract —
+``restore_checkpoint(..., broadcast=True)`` replicates every leaf across the
+mesh exactly like the reference's zero-non-root + push_pull trick did.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from ..common import logging as bps_log
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save_checkpoint(path: str, state: Any, force: bool = True) -> str:
+    """Save a pytree (TrainState or any params tree) to ``path``.
+
+    Multi-host: only process 0 writes (the reference's root-centric model);
+    call on every process — non-roots no-op.
+    """
+    path = os.path.abspath(path)
+    if jax.process_index() != 0:
+        return path
+    # orbax wants fully-addressable host arrays
+    host_state = jax.tree_util.tree_map(
+        lambda x: np.asarray(x) if hasattr(x, "dtype") else x, state
+    )
+    _checkpointer().save(path, host_state, force=force)
+    bps_log.info("checkpoint saved to %s", path)
+    return path
+
+
+def restore_checkpoint(
+    path: str,
+    template: Any = None,
+    broadcast: bool = True,
+    root_rank: int = 0,
+) -> Any:
+    """Restore a pytree from ``path``.
+
+    ``template`` (same structure, for dtype/shape guidance) is optional.
+    With ``broadcast=True`` the restored tree is pushed through
+    ``broadcast_parameters`` so every worker/device holds the root's bytes —
+    the reference's resume-consistency contract.
+    """
+    path = os.path.abspath(path)
+
+    def _load():
+        if template is not None:
+            return _checkpointer().restore(path, item=template)
+        return _checkpointer().restore(path)
+
+    if jax.process_count() > 1:
+        # save_checkpoint writes only on the root host: without a shared
+        # filesystem, non-roots reconstruct the tree from ``template`` and
+        # receive the root's bytes via the broadcast below (the reference's
+        # root-loads-then-broadcast resume pattern)
+        if jax.process_index() == root_rank:
+            restored = _load()
+        else:
+            try:
+                restored = _load()
+            except Exception:
+                if template is None:
+                    raise FileNotFoundError(
+                        f"checkpoint {path} not readable on process "
+                        f"{jax.process_index()} and no template given; "
+                        "multi-host restore without a shared filesystem "
+                        "requires template="
+                    )
+                restored = template
+        if not broadcast:
+            return restored
+        import byteps_tpu as bps
+
+        return bps.broadcast_parameters(restored, root_rank=root_rank)
+
+    restored = _load()
+    if broadcast:
+        import byteps_tpu as bps
+
+        restored = bps.broadcast_parameters(restored, root_rank=root_rank)
+    return restored
+
+
+class CheckpointManager:
+    """Rolling checkpoint manager (keep last k, save every n steps)."""
+
+    def __init__(self, directory: str, save_every: int = 1000, keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        self.save_every = max(1, save_every)
+        self.keep = keep
+        os.makedirs(self.directory, exist_ok=True)
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{step:010d}")
+
+    def steps(self):
+        out = []
+        if not os.path.isdir(self.directory):
+            return out
+        for d in os.listdir(self.directory):
+            if d.startswith("step_"):
+                try:
+                    out.append(int(d[len("step_"):]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def maybe_save(self, state: Any, step: int) -> Optional[str]:
+        if step % self.save_every != 0:
+            return None
+        path = save_checkpoint(self._step_dir(step), state)
+        if jax.process_index() == 0:
+            for old in self.steps()[: -self.keep] if self.keep > 0 else []:
+                import shutil
+
+                shutil.rmtree(self._step_dir(old), ignore_errors=True)
+        return path
+
+    def restore_latest(self, template: Any = None, broadcast: bool = True):
+        steps = self.steps()
+        if not steps:
+            return None, -1
+        step = steps[-1]
+        return (
+            restore_checkpoint(self._step_dir(step), template, broadcast),
+            step,
+        )

@@ -26,11 +26,6 @@ void bps_sum_f32(float* dst, const float* src, int64_t n) {
   for (int64_t i = 0; i < n; ++i) dst[i] += src[i];
 }
 
-void bps_sum3_f32(float* dst, const float* a, const float* b, int64_t n) {
-#pragma omp parallel for simd schedule(static)
-  for (int64_t i = 0; i < n; ++i) dst[i] = a[i] + b[i];
-}
-
 void bps_sum_f64(double* dst, const double* src, int64_t n) {
 #pragma omp parallel for simd schedule(static)
   for (int64_t i = 0; i < n; ++i) dst[i] += src[i];

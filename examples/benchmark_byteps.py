@@ -115,12 +115,14 @@ def main():
 
     for _ in range(args.num_warmup):
         state, metrics = step(state, batch)
-    jax.block_until_ready(metrics)
+    jax.block_until_ready((state, metrics))
 
     t0 = time.perf_counter()
     for _ in range(args.num_iters):
         state, metrics = step(state, batch)
-    jax.block_until_ready(metrics)
+    # block on the FULL state (not just metrics): async dispatch otherwise
+    # under-reports step time on the tunneled TPU (see bench.py)
+    jax.block_until_ready((state, metrics))
     dt = (time.perf_counter() - t0) / args.num_iters
 
     unit = "tokens" if args.model == "transformer" else "images"

@@ -97,15 +97,18 @@ def test_native_reducer_matches_numpy():
 
     if not reducer.available():
         pytest.skip("native lib unavailable")
+    import ml_dtypes
+
     rng = np.random.default_rng(0)
     for dtype, atol in [(np.float32, 1e-6), (np.float16, 2e-3),
+                        (ml_dtypes.bfloat16, 2e-2),
                         (np.int32, 0), (np.int64, 0), (np.float64, 1e-12)]:
-        if np.issubdtype(dtype, np.floating):
-            a = rng.standard_normal(1027).astype(dtype)
-            b = rng.standard_normal(1027).astype(dtype)
-        else:
+        if dtype in (np.int32, np.int64):
             a = rng.integers(-1000, 1000, 1027).astype(dtype)
             b = rng.integers(-1000, 1000, 1027).astype(dtype)
+        else:
+            a = rng.standard_normal(1027).astype(dtype)
+            b = rng.standard_normal(1027).astype(dtype)
         expect = (a.astype(np.float64) + b.astype(np.float64)) if atol else a + b
         got = a.copy()
         reducer.sum_into(got, b)
