@@ -36,17 +36,20 @@ WARMUP = 5
 ITERS = 30
 
 
+from byteps_tpu.common.timing import readback_barrier as _readback_barrier
+
+
 def _time_steps(fn, state, batch, iters):
     # warmup (includes compile)
     for _ in range(WARMUP):
         state, metrics = fn(state, batch)
-    jax.block_until_ready((state, metrics))
+    _readback_barrier(metrics, state)
     t0 = time.perf_counter()
     for _ in range(iters):
         state, metrics = fn(state, batch)
-    # block on the FULL output state: on this tunneled TPU, blocking on a
-    # small output alone under-reports (async dispatch returns early)
-    jax.block_until_ready((state, metrics))
+    # true completion barrier: value readback (block_until_ready lies on
+    # the tunneled TPU runtime; see common/timing.py)
+    _readback_barrier(metrics, state)
     return (time.perf_counter() - t0) / iters
 
 

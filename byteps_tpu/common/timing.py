@@ -1,0 +1,29 @@
+"""Benchmark timing helpers.
+
+On the tunneled TPU runtime used in this environment,
+``jax.block_until_ready`` acknowledges before device execution actually
+completes — even for chained, data-dependent dispatches — so any timing
+that ends with it under-reports wildly.  The only trustworthy completion
+barrier is an actual *value readback* that data-depends on the computation
+chain.  Every benchmark in this repo (bench.py, examples/benchmark_byteps.py)
+ends its timed region with ``readback_barrier``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def readback_barrier(*trees) -> float:
+    """Force true completion of everything the given pytrees depend on, by
+    summing one leaf of each to host.  Returns the checksum (useful to print
+    — it proves the computation really ran)."""
+    total = 0.0
+    for tree in trees:
+        leaves = jax.tree_util.tree_leaves(tree)
+        if not leaves:
+            continue
+        leaf = leaves[0]
+        total += float(jnp.sum(jnp.asarray(leaf).astype(jnp.float32)))
+    return total

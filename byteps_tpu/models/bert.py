@@ -1,0 +1,95 @@
+"""BERT-style bidirectional encoder.
+
+The reference's benchmark matrix includes a BERT-base fine-tune
+(BASELINE.json configs[3], run through ByteScheduler in the reference).
+Built from the same Block stack as the decoder (models/transformer.py) with
+``causal=False``, plus the two standard heads: sequence classification
+(fine-tune) and masked-LM (pretrain).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .transformer import Block, TransformerConfig
+
+
+def bert_config(
+    vocab_size: int = 30522,
+    num_layers: int = 12,
+    num_heads: int = 12,
+    d_model: int = 768,
+    d_ff: int = 3072,
+    max_seq_len: int = 512,
+    dtype: Any = jnp.bfloat16,
+    **kw,
+) -> TransformerConfig:
+    """BERT-base shape by default."""
+    return TransformerConfig(
+        vocab_size=vocab_size, num_layers=num_layers, num_heads=num_heads,
+        d_model=d_model, d_ff=d_ff, max_seq_len=max_seq_len, dtype=dtype,
+        causal=False, **kw,
+    )
+
+
+class BertEncoder(nn.Module):
+    """Token + position embeddings -> N bidirectional blocks -> hidden
+    states ``[B, T, d_model]``."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, attention_mask=None):
+        cfg = self.cfg
+        assert not cfg.causal, "BertEncoder requires causal=False"
+        x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                     name="embed")(tokens)
+        pos = nn.Embed(cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype,
+                       name="pos")(jnp.arange(tokens.shape[1])[None, :])
+        x = x + pos
+        # standard BERT padding semantics: padded keys are excluded from
+        # every layer's attention softmax (local_attention key_mask), and
+        # padded positions are zeroed in the output
+        for i in range(cfg.num_layers):
+            x = Block(cfg, name=f"block_{i}")(x, key_mask=attention_mask)
+        x = nn.RMSNorm(dtype=cfg.dtype, name="ln_f")(x)
+        if attention_mask is not None:
+            x = x * attention_mask[..., None].astype(x.dtype)
+        return x
+
+
+class BertClassifier(nn.Module):
+    """Sequence classification fine-tune head (CLS pooling)."""
+
+    cfg: TransformerConfig
+    num_classes: int = 2
+
+    @nn.compact
+    def __call__(self, tokens, attention_mask=None):
+        h = BertEncoder(self.cfg, name="encoder")(tokens, attention_mask)
+        cls = h[:, 0]  # [B, d_model]
+        cls = nn.tanh(nn.Dense(self.cfg.d_model, dtype=self.cfg.dtype,
+                               name="pooler")(cls))
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="classifier")(cls.astype(jnp.float32))
+
+
+class BertMLM(nn.Module):
+    """Masked-LM pretraining head (weight-tied output projection omitted
+    for simplicity; a plain vocab projection)."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, attention_mask=None):
+        h = BertEncoder(self.cfg, name="encoder")(tokens, attention_mask)
+        h = nn.gelu(nn.Dense(self.cfg.d_model, dtype=self.cfg.dtype,
+                             name="mlm_dense")(h))
+        h = nn.RMSNorm(dtype=self.cfg.dtype, name="mlm_ln")(h)
+        return nn.Dense(self.cfg.vocab_size, dtype=jnp.float32,
+                        name="mlm_out")(h.astype(jnp.float32))

@@ -47,7 +47,8 @@ class TransformerConfig:
     d_ff: int = 2048
     max_seq_len: int = 2048
     dtype: Any = jnp.bfloat16
-    attn_impl: str = "local"  # local | ring | ulysses
+    causal: bool = True  # False => bidirectional encoder (BERT-style)
+    attn_impl: str = "local"  # local | flash | ring | ulysses
     # mesh axis names; attention shard_map uses (dp_axis, sp_axis, tp_axis)
     dp_axis: str = "dp"
     sp_axis: str = "sp"
@@ -64,13 +65,24 @@ class TransformerConfig:
         return init
 
     def attention_fn(self):
+        causal = self.causal
+        names = set(self.mesh.axis_names) if self.mesh is not None else set()
+        has_sp = self.sp_axis in names and self.mesh.shape[self.sp_axis] > 1
+        if self.attn_impl == "flash":
+            if has_sp:
+                raise ValueError(
+                    "attn_impl='flash' is a single-shard kernel; with a "
+                    "sequence-parallel (sp) mesh axis use 'ring' or "
+                    "'ulysses' instead"
+                )
+            from ..ops.flash_attention import flash_attention
+
+            return lambda q, k, v: flash_attention(q, k, v, causal=causal)
         if self.attn_impl == "local" or self.mesh is None:
-            return lambda q, k, v: local_attention(q, k, v, causal=True)
+            return lambda q, k, v: local_attention(q, k, v, causal=causal)
         inner = ring_attention if self.attn_impl == "ring" else ulysses_attention
-        mesh = self.mesh
-        names = set(mesh.axis_names)
         if self.sp_axis not in names:
-            return lambda q, k, v: local_attention(q, k, v, causal=True)
+            return lambda q, k, v: local_attention(q, k, v, causal=causal)
         spec = P(
             self.dp_axis if self.dp_axis in names else None,
             self.sp_axis,
@@ -80,9 +92,9 @@ class TransformerConfig:
 
         from ..parallel.collectives import shard_map
 
-        fn = partial(inner, axis_name=self.sp_axis, causal=True)
+        fn = partial(inner, axis_name=self.sp_axis, causal=causal)
         return shard_map(
-            fn, mesh, in_specs=(spec, spec, spec), out_specs=spec
+            fn, mesh=self.mesh, in_specs=(spec, spec, spec), out_specs=spec
         )
 
 
@@ -90,7 +102,7 @@ class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, key_mask=None):
         cfg = self.cfg
         H, D = cfg.num_heads, cfg.d_model // cfg.num_heads
         proj = partial(
@@ -102,7 +114,14 @@ class Attention(nn.Module):
         q = proj(features=(H, D), name="q")(x)
         k = proj(features=(H, D), name="k")(x)
         v = proj(features=(H, D), name="v")(x)
-        out = cfg.attention_fn()(q, k, v)
+        if key_mask is not None:
+            # padding masks route through local attention (the sp-parallel
+            # impls don't take a mask; cfg.attention_fn raises first if an
+            # sp axis is active)
+            out = local_attention(q, k, v, causal=cfg.causal,
+                                  key_mask=key_mask)
+        else:
+            out = cfg.attention_fn()(q, k, v)
         return nn.DenseGeneral(
             features=cfg.d_model, axis=(-2, -1), dtype=cfg.dtype,
             use_bias=False, name="o",
@@ -137,9 +156,9 @@ class Block(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, key_mask=None):
         y = nn.RMSNorm(dtype=self.cfg.dtype, name="ln1")(x)
-        x = x + Attention(self.cfg, name="attn")(y)
+        x = x + Attention(self.cfg, name="attn")(y, key_mask=key_mask)
         y = nn.RMSNorm(dtype=self.cfg.dtype, name="ln2")(x)
         return x + MLP(self.cfg, name="mlp")(y)
 

@@ -1,7 +1,9 @@
 """byteps_tpu.ops — compression and Pallas kernels for the hot paths."""
 
 from .compression import BF16Compressor, Compression, Compressor, FP16Compressor, NoneCompressor
+from .flash_attention import flash_attention
 
 __all__ = [
     "Compression", "Compressor", "NoneCompressor", "FP16Compressor", "BF16Compressor",
+    "flash_attention",
 ]

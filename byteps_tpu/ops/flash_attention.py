@@ -1,0 +1,207 @@
+"""Pallas TPU flash attention (forward kernel).
+
+The hot op of the transformer stack, written for the MXU/VMEM rather than
+translated from any CUDA kernel: the grid walks (batch*heads, query blocks),
+K/V live in VMEM per (batch, head), and an online-softmax ``fori_loop``
+accumulates one key block at a time — no [T, T] score matrix ever
+materializes in HBM.  Causal masking prunes the loop to the lower-triangle
+blocks (the bubble work is skipped, not masked).
+
+Backward uses a custom_vjp whose residuals are just (q, k, v, o, lse): a
+``lax.scan`` over key blocks recomputes a ``[T, block_k]`` score slice at a
+time with standard XLA ops, so backward peak memory is O(T * block_k) like
+the forward (no [T, T] matrix ever materializes).  Combined with
+``parallel/ring_attention.py`` (which shards T across chips) this covers
+both the single-chip memory story and the multi-chip long-context story.
+
+Layout convention matches the rest of the stack: ``[B, T, H, D]``.
+``D`` should be a multiple of the 128-lane width for full MXU utilization
+(64 works; the compiler pads).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
+                causal: bool, scale: float):
+    # q_ref: [1, BQ, D]; k_ref/v_ref: [1, T, D]; o_ref: [1, BQ, D]
+    # lse_ref: [1, BQ]  (log-sum-exp, saved for the backward pass)
+    qi = pl.program_id(1)
+    block_q = q_ref.shape[1]
+    T = k_ref.shape[1]
+    D = q_ref.shape[2]
+    nk = T // block_k
+
+    q = q_ref[0].astype(jnp.float32) * scale  # [BQ, D]
+
+    acc0 = jnp.zeros((block_q, D), jnp.float32)
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [BQ, BK]
+        if causal:
+            row = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            col = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(row >= col, s, _NEG_INF)
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_blk)
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc_new, m_new, l_new
+
+    # causal: only blocks j*BK <= (qi+1)*BQ - 1 can contribute
+    n_iter = (
+        jnp.minimum(nk, (qi * block_q + block_q + block_k - 1) // block_k)
+        if causal else nk
+    )
+    acc, m, l = lax.fori_loop(0, n_iter, body, (acc0, m0, l0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l)  # [BQ, 1]
+
+
+def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+    B, T, H, D = q.shape
+    bq = min(block_q, T)
+    bk = min(block_k, T)
+    if T % bq or T % bk:
+        raise ValueError(f"seq len {T} must be divisible by block sizes "
+                         f"({bq}, {bk})")
+    # fold heads into the batch grid dim; [B, T, H, D] -> [B*H, T, D]
+    qf = q.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+
+    kernel = functools.partial(
+        _fwd_kernel, block_k=bk, causal=causal, scale=scale)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(B * H, T // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            # lse kept 3-D: TPU requires the last two block dims divisible
+            # by (8, 128) or equal to the full array dims — (bq, 1) is
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, T, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return o.reshape(B, H, T, D).transpose(0, 2, 1, 3), lse[..., 0]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool = False,
+) -> jax.Array:
+    """Exact attention, O(T) memory forward.  q/k/v: ``[B, T, H, D]``."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    o, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    return o
+
+
+def _fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    o, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                            interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _bwd_rule(causal, scale, block_q, block_k, interpret, res, do):
+    """Blockwise backward: lax.scan over key blocks so only a [T, BK] score
+    slice is ever live — the O(T * BK) memory analog of the forward kernel
+    (no [T, T] matrix materializes)."""
+    q, k, v, o, lse = res
+    B, T, H, D = q.shape
+    scale = scale if scale is not None else D ** -0.5
+    bk = min(block_k, T)
+    nk = T // bk
+
+    # fold batch & heads: [B, T, H, D] -> [BH, T, D]
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, T, x.shape[-1])
+
+    qf = fold(q).astype(jnp.float32) * scale
+    kf = fold(k).astype(jnp.float32)
+    vf = fold(v).astype(jnp.float32)
+    dof = fold(do).astype(jnp.float32)
+    of = fold(o).astype(jnp.float32)
+    lse_f = lse  # already [BH, T]
+    delta = jnp.sum(dof * of, axis=-1)  # [BH, T]
+
+    pos_q = jnp.arange(T)
+
+    def body(dq_acc, j):
+        kj = lax.dynamic_slice_in_dim(kf, j * bk, bk, axis=1)  # [BH,BK,D]
+        vj = lax.dynamic_slice_in_dim(vf, j * bk, bk, axis=1)
+        s = jnp.einsum("btd,bkd->btk", qf, kj,
+                       preferred_element_type=jnp.float32)  # [BH,T,BK]
+        if causal:
+            col = j * bk + jnp.arange(bk)
+            s = jnp.where(pos_q[:, None] >= col[None, :], s, _NEG_INF)
+        p = jnp.exp(s - lse_f[..., None])
+        dv_j = jnp.einsum("btk,btd->bkd", p, dof,
+                          preferred_element_type=jnp.float32)
+        dp = jnp.einsum("btd,bkd->btk", dof, vj,
+                        preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[..., None])
+        dq_acc = dq_acc + jnp.einsum("btk,bkd->btd", ds, kj,
+                                     preferred_element_type=jnp.float32)
+        dk_j = jnp.einsum("btk,btd->bkd", ds, qf,
+                          preferred_element_type=jnp.float32)
+        return dq_acc, (dk_j, dv_j)
+
+    dq0 = jnp.zeros_like(qf)
+    dq, (dk_blocks, dv_blocks) = lax.scan(body, dq0, jnp.arange(nk))
+    dq = dq * scale
+    # [nk, BH, BK, D] -> [BH, T, D]
+    dk = dk_blocks.transpose(1, 0, 2, 3).reshape(B * H, T, D)
+    dv = dv_blocks.transpose(1, 0, 2, 3).reshape(B * H, T, D)
+
+    def unfold(x, dtype):
+        return x.reshape(B, H, T, D).transpose(0, 2, 1, 3).astype(dtype)
+
+    return unfold(dq, q.dtype), unfold(dk, k.dtype), unfold(dv, v.dtype)
+
+
+flash_attention.defvjp(_fwd_rule, _bwd_rule)

@@ -150,8 +150,12 @@ def ulysses_attention(
     return to_seq(out)
 
 
-def local_attention(q, k, v, causal=False, scale=None):
-    """Plain (non-parallel) reference attention, same convention."""
+def local_attention(q, k, v, causal=False, scale=None, key_mask=None):
+    """Plain (non-parallel) reference attention, same convention.
+
+    ``key_mask``: optional ``[B, S]`` keep-mask (1 = attend, 0 = ignore) —
+    padded keys are excluded from the softmax (standard BERT padding
+    semantics)."""
     D = q.shape[-1]
     scale = scale if scale is not None else D ** -0.5
     s = jnp.einsum(
@@ -162,7 +166,10 @@ def local_attention(q, k, v, causal=False, scale=None):
         T, S = q.shape[1], k.shape[1]
         mask = jnp.arange(T)[:, None] >= jnp.arange(S)[None, :]
         s = jnp.where(mask[None, :, None, :], s, -jnp.inf)
+    if key_mask is not None:
+        s = jnp.where(key_mask[:, None, None, :].astype(bool), s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
+    p = jnp.nan_to_num(p)  # rows with every key masked
     return jnp.einsum(
         "bqhk,bkhd->bqhd", p, v.astype(jnp.float32),
         preferred_element_type=jnp.float32,

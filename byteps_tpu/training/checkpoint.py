@@ -67,10 +67,10 @@ def restore_checkpoint(
         return _checkpointer().restore(path)
 
     if jax.process_count() > 1:
-        # save_checkpoint writes only on the root host: without a shared
-        # filesystem, non-roots reconstruct the tree from ``template`` and
-        # receive the root's bytes via the broadcast below (the reference's
-        # root-loads-then-broadcast resume pattern)
+        # save_checkpoint writes only on process 0: process 0 is therefore
+        # always the loader, and the broadcast sources from it regardless
+        # of root_rank (the reference's root-loads-then-broadcast pattern)
+        root_rank = 0
         if jax.process_index() == root_rank:
             restored = _load()
         else:

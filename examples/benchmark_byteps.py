@@ -113,16 +113,19 @@ def main():
     build = build_transformer if args.model == "transformer" else build_vision
     step, state, batch, global_batch = build(args, mesh)
 
+    from byteps_tpu.common.timing import readback_barrier
+
+    def barrier():
+        return readback_barrier(metrics, state)
+
     for _ in range(args.num_warmup):
         state, metrics = step(state, batch)
-    jax.block_until_ready((state, metrics))
+    barrier()
 
     t0 = time.perf_counter()
     for _ in range(args.num_iters):
         state, metrics = step(state, batch)
-    # block on the FULL state (not just metrics): async dispatch otherwise
-    # under-reports step time on the tunneled TPU (see bench.py)
-    jax.block_until_ready((state, metrics))
+    barrier()
     dt = (time.perf_counter() - t0) / args.num_iters
 
     unit = "tokens" if args.model == "transformer" else "images"
