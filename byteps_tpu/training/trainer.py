@@ -1,0 +1,127 @@
+"""High-level training driver — the analog of the reference's Gluon
+``DistributedTrainer`` (mxnet/__init__.py:142-204) and the Keras callback
+stack: owns the step function, broadcast-at-start, metric averaging,
+checkpointing, and the train loop, so user code is just model + data.
+
+Example::
+
+    trainer = Trainer(
+        loss_fn=classification_loss_fn(model),
+        optimizer=optax.sgd(warmup_schedule(0.1, bps.size(), 500), momentum=0.9),
+        checkpoint_dir="/tmp/ckpts",
+    )
+    trainer.fit(params, model_state, data_iter, steps=1000)
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Iterable, Optional, Sequence
+
+import jax
+import optax
+
+import byteps_tpu as bps
+
+from ..common import logging as bps_log
+from ..ops.compression import Compression
+from .callbacks import average_metrics
+from .checkpoint import CheckpointManager
+from .step import TrainState, make_data_parallel_step, shard_batch
+
+
+class Trainer:
+    def __init__(
+        self,
+        loss_fn: Callable,
+        optimizer: optax.GradientTransformation,
+        mesh=None,
+        axes: Sequence[str] = ("dp",),
+        compression: type = Compression.none,
+        backward_passes_per_step: int = 1,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every: int = 1000,
+        checkpoint_keep: int = 3,
+        log_every: int = 100,
+        callbacks: Sequence[Callable] = (),
+    ):
+        bps.init()
+        self.mesh = mesh if mesh is not None else bps.mesh()
+        self.step_fn = make_data_parallel_step(
+            loss_fn, optimizer, self.mesh, axes=tuple(axes),
+            compression=compression,
+            backward_passes_per_step=backward_passes_per_step,
+        )
+        self.ckpt = (
+            CheckpointManager(checkpoint_dir, checkpoint_every, checkpoint_keep)
+            if checkpoint_dir else None
+        )
+        self.log_every = log_every
+        self.callbacks = list(callbacks)
+        self.state: Optional[TrainState] = None
+
+    # ------------------------------------------------------------------ api
+
+    def init_state(self, params, model_state=None, root_rank: int = 0,
+                   resume: bool = True) -> TrainState:
+        """Broadcast-consistent init (reference BroadcastGlobalVariables
+        semantics), optionally resuming from the latest checkpoint."""
+        if self.ckpt is not None and resume:
+            state = self.step_fn.init_state(params, model_state=model_state)
+            restored, step = self.ckpt.restore_latest(template=tuple(state))
+            if restored is not None:
+                bps_log.info("resuming from checkpoint step %d", step)
+                return TrainState(*restored)
+        params = bps.broadcast_parameters(params, root_rank=root_rank)
+        if model_state:
+            model_state = bps.broadcast_parameters(model_state, root_rank)
+        return self.step_fn.init_state(params, model_state=model_state)
+
+    def fit(
+        self,
+        params,
+        model_state,
+        batches: Iterable,
+        steps: Optional[int] = None,
+    ) -> TrainState:
+        state = self.state or self.init_state(params, model_state)
+        t0 = time.time()
+        seen = 0
+        for i, batch in enumerate(batches):
+            if steps is not None and i >= steps:
+                break
+            batch = shard_batch(batch, self.mesh)
+            state, metrics = self.step_fn(state, batch)
+            seen += 1
+            step_no = int(state.step)
+            if self.ckpt is not None:
+                self.ckpt.maybe_save(tuple(state), step_no)
+            for cb in self.callbacks:
+                maybe = cb(state)
+                if maybe is not None:
+                    state = maybe
+            if self.log_every and seen % self.log_every == 0:
+                avg = average_metrics(
+                    {k: v for k, v in metrics.items()}
+                )
+                rate = seen / max(time.time() - t0, 1e-9)
+                bps_log.info(
+                    "step %d %s (%.2f steps/s)", step_no,
+                    {k: round(v, 4) for k, v in avg.items()}, rate,
+                )
+        self.state = state
+        return state
+
+    def evaluate(self, eval_fn: Callable, batches: Iterable) -> Dict[str, float]:
+        """Average ``eval_fn(state, batch) -> {metric: scalar}`` over
+        batches and across workers (reference MetricAverageCallback)."""
+        sums: Dict[str, float] = {}
+        n = 0
+        for batch in batches:
+            batch = shard_batch(batch, self.mesh)
+            out = eval_fn(self.state, batch)
+            for k, v in out.items():
+                sums[k] = sums.get(k, 0.0) + float(v)
+            n += 1
+        means = {k: v / max(n, 1) for k, v in sums.items()}
+        return average_metrics(means)
