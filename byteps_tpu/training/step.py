@@ -147,7 +147,17 @@ def shard_batch(batch, mesh: Mesh, axes: Sequence[str] = ("dp",)):
 
 def replicate_state(state, mesh: Mesh):
     sharding = NamedSharding(mesh, P())
-    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), state)
+    # Copy committed jax.Arrays before placing: device_put may alias their
+    # buffers into the replicated output, and TrainState is donated into the
+    # jitted step — without the copy, donation would delete the caller's
+    # arrays too.  Host (numpy/scalar) leaves are always copied by
+    # device_put itself, so no extra materialization for them.
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(
+            jnp.array(x) if isinstance(x, jax.Array) else x, sharding
+        ),
+        state,
+    )
 
 
 def classification_loss_fn(model, train: bool = True, rngs_fn=None):

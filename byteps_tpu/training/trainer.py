@@ -87,19 +87,39 @@ class Trainer:
         state = self.state or self.init_state(params, model_state)
         t0 = time.time()
         seen = 0
+        # Track the step number on host: reading int(state.step) every
+        # iteration would force a device sync per step and serialize the
+        # async dispatch pipeline whose overlap is the performance story.
+        start_step = int(state.step)
+        # Bounded in-flight window: unbounded async dispatch of
+        # data-dependent steps can starve XLA's collective rendezvous (the
+        # virtual-CPU harness SIGABRTs); blocking on the state from a few
+        # steps back keeps ≤window steps in flight while preserving overlap.
+        window = 4
+        inflight: list = []
         for i, batch in enumerate(batches):
             if steps is not None and i >= steps:
                 break
             batch = shard_batch(batch, self.mesh)
             state, metrics = self.step_fn(state, batch)
+            # metrics (not state) goes in the window: state buffers are
+            # donated into the next step and blocking on a donated array
+            # would raise; metrics data-depends on the full step.
+            inflight.append(metrics)
+            if len(inflight) > window:
+                jax.block_until_ready(inflight.pop(0))
             seen += 1
-            step_no = int(state.step)
+            step_no = start_step + seen
             if self.ckpt is not None:
                 self.ckpt.maybe_save(tuple(state), step_no)
             for cb in self.callbacks:
                 maybe = cb(state)
                 if maybe is not None:
                     state = maybe
+                    # A callback may have replaced state (e.g. rollback) —
+                    # resync the host-side counter with the device counter
+                    # so checkpoint step numbers stay consistent.
+                    start_step = int(state.step) - seen
             if self.log_every and seen % self.log_every == 0:
                 avg = average_metrics(
                     {k: v for k, v in metrics.items()}

@@ -61,6 +61,10 @@ def test_ef_quant_composes_with_push_pull_training():
     batch = shard_batch({"x": x, "y": x @ w_true}, mesh)
     for _ in range(150):
         state, metrics = step(state, batch)
+        # Block each step: unbounded async dispatch of data-dependent jitted
+        # steps can starve XLA's in-process CPU collective rendezvous on the
+        # virtual 8-device harness (observed SIGABRT after ~40s).
+        jax.block_until_ready(state)
     assert float(metrics["loss"]) < 1e-2
     np.testing.assert_allclose(np.asarray(state.params["w"]), np.asarray(w_true),
                                atol=0.05)
