@@ -52,9 +52,18 @@ def _maybe_distributed_init() -> None:
             return  # already initialized
     except Exception:
         pass
+    # DMLC contract fallbacks (cfg.num_worker/worker_id mirror
+    # DMLC_NUM_WORKER/DMLC_WORKER_ID — reference global.cc:105-119) let the
+    # bootstrap work without the launcher's derived BYTEPS_* vars.
+    cfg = get_config()
     addr = os.environ.get("BYTEPS_COORDINATOR_ADDR")
-    nproc = int(os.environ.get("BYTEPS_NUM_PROCESSES", "1"))
-    pid = int(os.environ.get("BYTEPS_PROCESS_ID", "0"))
+    if addr is None and os.environ.get("DMLC_PS_ROOT_URI"):
+        addr = (
+            os.environ["DMLC_PS_ROOT_URI"]
+            + ":" + os.environ.get("DMLC_PS_ROOT_PORT", "1234")
+        )
+    nproc = int(os.environ.get("BYTEPS_NUM_PROCESSES", cfg.num_worker))
+    pid = int(os.environ.get("BYTEPS_PROCESS_ID", cfg.worker_id))
     if addr and nproc > 1:
         jax.distributed.initialize(
             coordinator_address=addr, num_processes=nproc, process_id=pid
@@ -93,9 +102,18 @@ def init(
         cfg = get_config()
         if mesh is None:
             shape = mesh_shape or _mesh_mod.parse_mesh_shape(cfg.mesh_shape)
-            mesh = _mesh_mod.build_mesh(devices=devices, mesh_shape=shape or None)
+            mesh = _mesh_mod.build_mesh(
+                devices=devices, mesh_shape=shape or None,
+                force_distributed=cfg.force_distributed,
+            )
         _state.mesh = mesh
         _state.reduce_axes = _mesh_mod.reduce_axes(mesh)
+        if cfg.num_worker > 1 and jax.process_count() == 1:
+            bps_log.warning(
+                "DMLC_NUM_WORKER=%d but only 1 process is attached — "
+                "launch via byteps_tpu.launcher (or set the BYTEPS_* "
+                "coordinator vars) for a multi-host run", cfg.num_worker,
+            )
         _dispatcher.start_engine(mesh, _state.reduce_axes)
         _state.initialized = True
         bps_log.info(
@@ -144,12 +162,20 @@ def rank() -> int:
 
 
 def local_rank() -> int:
-    return jax.process_index()
+    """Launcher-injected BYTEPS_LOCAL_RANK wins (reference
+    launcher/launch.py:43-60 contract); else the process index."""
+    cfg = get_config()
+    return cfg.local_rank if cfg.local_rank is not None else jax.process_index()
 
 
 def local_size() -> int:
-    """Devices handled by this process (reference byteps_local_size)."""
-    return jax.local_device_count()
+    """Launcher-injected BYTEPS_LOCAL_SIZE wins; else the devices handled by
+    this process (reference byteps_local_size)."""
+    cfg = get_config()
+    return (
+        cfg.local_size if cfg.local_size is not None
+        else jax.local_device_count()
+    )
 
 
 def declare(name: str) -> int:
@@ -218,9 +244,20 @@ def push_pull_async(
     priority: int = 0,
     compression: type = Compression.none,
 ) -> int:
-    """Async eager push_pull; returns a handle (reference torch/ops.py:144-183)."""
+    """Async eager push_pull; returns a handle (reference torch/ops.py:144-183).
+
+    Multi-process (multi-controller SPMD) runs: ``tensor`` is **this
+    process's contribution** (every process must call with the same name, in
+    the same order — the reference's declaration contract); the reduce runs
+    as one jitted SPMD program over the global mesh and the handle completes
+    synchronously.  Single-process runs: contributions are stacked on a
+    leading worker axis and drained by the engine's scheduler threads.
+    """
     _require_init()
     engine = _dispatcher.get_engine()
+    wire = getattr(compression, "wire_dtype", None)
+    if jax.process_count() > 1:
+        return _multihost_push_pull(tensor, average=average, wire=wire)
     n = size()
     tensor = jnp.asarray(tensor)
     if n == 1:
@@ -233,7 +270,6 @@ def push_pull_async(
             f"on a leading worker axis of length {n}; got shape {tensor.shape}. "
             "Inside a jitted step, pass axis_name= instead."
         )
-    wire = getattr(compression, "wire_dtype", None)
     return engine.push_pull_async(
         stacked,
         name or _auto_name(),
@@ -242,6 +278,47 @@ def push_pull_async(
         version=version,
         wire_dtype=wire,
     )
+
+
+def _multihost_push_pull(tensor, average: bool, wire) -> int:
+    """Cross-process eager reduce: every process contributes its local
+    slots' tensors, the collective spans the whole mesh (the role of the
+    reference's ps-lite ZPush/ZPull across machines, core_loops.cc:430-502).
+
+    Runs synchronously (SPMD programs must be entered by all processes in
+    the same order, so deferring to per-process scheduler threads could
+    diverge); the returned handle is already complete.
+    """
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    engine = _dispatcher.get_engine()
+    mesh, axes = _state.mesh, tuple(_state.reduce_axes)
+    local = np.asarray(tensor)
+    # One worker == one *process* here (Horovod semantics).  The mesh's
+    # reduce axes span all devices, so the process's single contribution is
+    # replicated into its local_device_count slots pre-divided by that
+    # count: the mesh-wide sum then equals the sum over processes exactly,
+    # independent of host topology.
+    slots = jax.local_device_count()
+    if slots > 1:
+        local = local / slots
+    local = np.broadcast_to(local, (slots,) + local.shape).astype(
+        local.dtype, copy=False
+    )
+    sharding = NamedSharding(mesh, P(axes))
+    stacked = jax.make_array_from_process_local_data(sharding, local)
+    out = _collectives.push_pull_stacked(
+        stacked, mesh, axes, average=False,
+        wire_dtype=np.dtype(wire).name if wire is not None else None,
+    )
+    if average:
+        out = out / jax.process_count()
+    handle = engine.handles.allocate()
+    from .common.types import Status
+
+    engine.handles.mark_done(handle, Status.OK(), out)
+    return handle
 
 
 def poll(handle: int) -> bool:

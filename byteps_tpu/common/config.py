@@ -32,6 +32,11 @@ def _env_int(name: str, default: int) -> int:
     return int(v)
 
 
+def _env_opt_int(name: str) -> Optional[int]:
+    v = os.environ.get(name)
+    return None if v is None or v == "" else int(v)
+
+
 def _env_bool(name: str, default: bool = False) -> bool:
     v = os.environ.get(name)
     if v is None or v == "":
@@ -67,8 +72,10 @@ class Config:
     # --- cluster contract (reference communicator.cc:60-124, docs/env.md) -
     num_worker: int = 1
     worker_id: int = 0
-    local_rank: int = 0
-    local_size: int = 1
+    # None = not launcher-injected; api.local_rank()/local_size() then fall
+    # back to jax.process_index()/jax.local_device_count()
+    local_rank: Optional[int] = None
+    local_size: Optional[int] = None
     num_server: int = 1
     force_distributed: bool = False
 
@@ -93,8 +100,8 @@ class Config:
             group_size=_env_int("BYTEPS_NCCL_GROUP_SIZE", 4),
             num_worker=_env_int("DMLC_NUM_WORKER", 1),
             worker_id=_env_int("DMLC_WORKER_ID", 0),
-            local_rank=_env_int("BYTEPS_LOCAL_RANK", 0),
-            local_size=_env_int("BYTEPS_LOCAL_SIZE", 1),
+            local_rank=_env_opt_int("BYTEPS_LOCAL_RANK"),
+            local_size=_env_opt_int("BYTEPS_LOCAL_SIZE"),
             num_server=_env_int("DMLC_NUM_SERVER", 1),
             force_distributed=_env_bool("BYTEPS_FORCE_DISTRIBUTED"),
             enable_async=_env_bool("BYTEPS_ENABLE_ASYNC"),
@@ -105,6 +112,27 @@ class Config:
             wire_dtype=_env_str("BYTEPS_WIRE_DTYPE", ""),
             mesh_shape=_env_str("BYTEPS_MESH_SHAPE", ""),
         )
+
+    @property
+    def wire_jnp_dtype(self):
+        """``BYTEPS_WIRE_DTYPE`` as a jnp dtype (None = no cast) — single
+        source of truth for the eager engine and the jitted optimizer."""
+        if not self.wire_dtype:
+            return None
+        import jax.numpy as jnp
+
+        return {"bf16": jnp.bfloat16, "fp16": jnp.float16}.get(self.wire_dtype)
+
+    @property
+    def effective_partition_bytes(self) -> int:
+        """Partition bound aligned down to ``partition_align`` (reference
+        global.cc:96-103 aligns to 8 x local_size bytes so shards split
+        evenly; we align so every partition reduce-scatters evenly over a
+        mesh axis)."""
+        if self.partition_align <= 1:
+            return self.partition_bytes
+        aligned = self.partition_bytes - self.partition_bytes % self.partition_align
+        return max(self.partition_align, aligned)
 
     @property
     def effective_credit(self) -> int:

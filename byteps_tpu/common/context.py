@@ -93,6 +93,21 @@ class TensorRegistry:
             self._next_key = 0
 
 
+def name_key(name: str) -> int:
+    """Order-independent PS key for store sharding.
+
+    Workers may declare tensors in different local orders, so placement for
+    the async-PS store must derive from the *name*, not the monotonic
+    declared_key (which the reference keeps consistent only by convention —
+    sorted declaration, torch/__init__.py:90-95).  crc32&0xFFFF fills the
+    declared_key slot of the reference keyspace layout, so the sharding
+    formula downstream is unchanged.
+    """
+    import zlib
+
+    return (zlib.crc32(name.encode()) & 0xFFFF) << 16
+
+
 def partition_key(declared_key: int, partition_index: int) -> int:
     """Keyspace layout of reference operations.cc:214-230."""
     if not 0 <= partition_index < MAX_PARTITIONS:

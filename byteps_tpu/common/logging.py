@@ -32,7 +32,11 @@ def get_logger() -> logging.Logger:
     if _logger is not None:
         return _logger
     logger = logging.getLogger("byteps_tpu")
-    level_name = os.environ.get("BYTEPS_LOG_LEVEL", "WARNING").upper()
+    # level comes from the typed config (which reads BYTEPS_LOG_LEVEL) so
+    # set_config() programmatic overrides are honored too
+    from .config import get_config
+
+    level_name = get_config().log_level.upper()
     logger.setLevel(_LEVELS.get(level_name, logging.WARNING))
     if not logger.handlers:
         handler = logging.StreamHandler(sys.stderr)

@@ -3,9 +3,12 @@
 A ``key -> count`` map with an expected count per key; a key becomes ready
 when its count reaches the expectation (reference ready_table.cc:17-41).  The
 reference keeps one instance per pipeline role (push/copy/pcie-reduce/
-nccl-reduce/broadcast, global.cc:147-167); under SPMD most of those barriers
-dissolve, but the eager engine still uses one to gate bucket dispatch on all
-of a bucket's constituent gradients having arrived.
+nccl-reduce/broadcast, global.cc:147-167); under SPMD the cross-rank
+instances dissolve, and one survives: the eager engine's
+partition-completion barrier (engine/dispatcher.py) — a push_pull's result
+is assembled only once every partition's collective has landed, the role
+the shared atomic counter + FinishOrProceed play in the reference
+(common.h:170-209, core_loops.cc:27-82), keyed by handle.
 """
 
 from __future__ import annotations
@@ -32,6 +35,16 @@ class ReadyTable:
             self._count[key] = self._count.get(key, 0) + n
             return self._count[key]
 
+    def add_and_check(self, key: int, n: int = 1) -> bool:
+        """Atomically add and report whether this addition *completed* the
+        key (count crossed the expectation exactly now) — true for exactly
+        one caller even under concurrent completions."""
+        with self._lock:
+            expected = self._expected.get(key, self._expected_default)
+            before = self._count.get(key, 0)
+            self._count[key] = before + n
+            return before < expected <= before + n
+
     def is_key_ready(self, key: int) -> bool:
         """Reference ready_table.cc:17-27."""
         with self._lock:
@@ -42,3 +55,10 @@ class ReadyTable:
         """Reference ready_table.cc:37-41."""
         with self._lock:
             self._count.pop(key, None)
+
+    def clear_key(self, key: int) -> None:
+        """Drop both count and per-key expectation (end of a key's life —
+        keeps the table bounded for handle-keyed use)."""
+        with self._lock:
+            self._count.pop(key, None)
+            self._expected.pop(key, None)

@@ -8,12 +8,20 @@ current global state — no global barrier, so fast workers never wait for
 stragglers; gradients are applied stale.
 
 TPU-native rendering: the "server tier" is a host-side store (HBM-external,
-like the reference's CPU servers).  Under single-controller JAX the store
-lives in host RAM of the controller process; in a multi-host deployment each
-process holds the shard of the store for its own key range (the analog of
-the reference's key->server sharding, global.cc:305-334) and exchanges
-deltas over DCN via ``jax.experimental.multihost_utils`` — the hot
-summation loop optionally runs in the native C++ reducer
+like the reference's CPU servers).  Three deployment shapes:
+
+  * in-process: ``AsyncParameterServer`` (one shard) for threads sharing a
+    controller process;
+  * in-process sharded: ``ShardedParameterStore`` splits the keyspace over
+    ``DMLC_NUM_SERVER`` shards with the reference's key->server placement
+    (global.cc:305-334, via common.context.ServerSharder), each shard with
+    its own lock so pushes to different shards never contend;
+  * cross-process: ``engine.ps_server`` runs a shard as a TCP server
+    process (launcher role ``server`` — the ps-lite/MXNet-server analog)
+    and ``RemoteStore`` is the client with the same interface as the
+    in-process stores.
+
+The hot summation loop optionally runs in the native C++ reducer
 (byteps_tpu/native, OpenMP), mirroring the reference's cpu_reducer.cc role
 on the server.
 
@@ -99,6 +107,119 @@ class AsyncParameterServer:
     def names(self) -> List[str]:
         with self._global_lock:
             return list(self._store)
+
+
+class ShardedParameterStore:
+    """Keyspace-sharded store: ``num_shards`` independent
+    ``AsyncParameterServer`` shards with reference-compatible placement
+    (``(((key>>16)+key%65536)*9973) % num_shards`` or hash under
+    ``use_hash`` — global.cc:305-334).  Same interface as a single shard,
+    so ``AsyncWorker`` works against either.
+    """
+
+    def __init__(self, num_shards: int = 1, use_hash: bool = False,
+                 use_native: bool = True):
+        from ..common.context import ServerSharder
+
+        self.num_shards = max(1, int(num_shards))
+        self._shards = [
+            AsyncParameterServer(use_native=use_native)
+            for _ in range(self.num_shards)
+        ]
+        self._sharder = ServerSharder(self.num_shards, use_hash=use_hash)
+
+    def shard_of(self, name: str, nbytes: int = 0) -> int:
+        """Name-derived key -> shard placement (load-accounted like the
+        reference's per-server byte log).  Placement must not depend on a
+        worker's local declaration order — see common.context.name_key."""
+        from ..common.context import name_key
+
+        return self._sharder.place(name_key(name), nbytes)
+
+    def init_tensor(self, name: str, value: np.ndarray) -> None:
+        self._shards[self.shard_of(name)].init_tensor(name, value)
+
+    def push_delta(self, name: str, delta: np.ndarray) -> None:
+        d = np.asarray(delta)
+        self._shards[self.shard_of(name, d.nbytes)].push_delta(name, d)
+
+    def pull(self, name: str) -> np.ndarray:
+        return self._shards[self.shard_of(name)].pull(name)
+
+    def push_pull(self, name: str, delta: np.ndarray) -> np.ndarray:
+        d = np.asarray(delta)
+        return self._shards[self.shard_of(name, d.nbytes)].push_pull(name, d)
+
+    def version(self, name: str) -> int:
+        return self._shards[self.shard_of(name)].version(name)
+
+    def names(self) -> List[str]:
+        out: List[str] = []
+        for s in self._shards:
+            out.extend(s.names())
+        return out
+
+    def load(self) -> List[int]:
+        """Accumulated bytes per shard (reference global.cc:322-325)."""
+        return self._sharder.load()
+
+
+_default_store: Optional[Any] = None
+_default_store_lock = threading.Lock()
+
+
+def get_async_store():
+    """Process-default store for async-PS mode, built from the env contract:
+
+    * ``BYTEPS_SERVER_ADDRS`` (or DMLC_PS_ROOT_URI + DMLC_NUM_SERVER) set
+      -> ``RemoteStore`` over the TCP server tier (engine.ps_server);
+    * otherwise -> in-process ``ShardedParameterStore`` with
+      ``DMLC_NUM_SERVER`` shards and ``BYTEPS_USE_HASH_KEY`` placement.
+    """
+    global _default_store
+    with _default_store_lock:
+        if _default_store is None:
+            from ..common.config import get_config
+
+            cfg = get_config()
+            addrs = _server_addrs_from_env()
+            if addrs:
+                from .ps_server import RemoteStore
+
+                _default_store = RemoteStore(addrs, use_hash=cfg.use_hash_key)
+            else:
+                _default_store = ShardedParameterStore(
+                    num_shards=cfg.num_server, use_hash=cfg.use_hash_key
+                )
+        return _default_store
+
+
+def set_async_store(store) -> None:
+    global _default_store
+    with _default_store_lock:
+        _default_store = store
+
+
+def reset_async_store() -> None:
+    set_async_store(None)
+
+
+def _server_addrs_from_env() -> List[str]:
+    """Worker-side server discovery: explicit ``BYTEPS_SERVER_ADDRS``
+    ("host:port,host:port"), else derived from the DMLC contract the way the
+    reference's ps-lite rendezvous hands out server ports (root port + 100 +
+    server index)."""
+    import os
+
+    explicit = os.environ.get("BYTEPS_SERVER_ADDRS", "")
+    if explicit:
+        return [a.strip() for a in explicit.split(",") if a.strip()]
+    uri = os.environ.get("DMLC_PS_ROOT_URI", "")
+    nserver = int(os.environ.get("DMLC_NUM_SERVER", "0") or "0")
+    if uri and nserver > 0 and os.environ.get("BYTEPS_ENABLE_ASYNC", "0") == "1":
+        root = int(os.environ.get("DMLC_PS_ROOT_PORT", "1234"))
+        return [f"{uri}:{root + 100 + i}" for i in range(nserver)]
+    return []
 
 
 class AsyncWorker:

@@ -36,6 +36,7 @@ from ..common.config import get_config
 from ..common.tracing import get_tracer
 from ..common.context import TensorRegistry, partition_key
 from ..common.partition import partition_offsets
+from ..common.ready_table import ReadyTable
 from ..common.scheduler import ScheduledQueue
 from ..common.types import QueueType, Status, TensorTaskEntry
 from ..parallel import collectives
@@ -43,13 +44,15 @@ from .handles import HandleManager
 
 
 class _PushPullRequest:
-    """Book-keeping for one user-level push_pull spanning >=1 partitions."""
+    """Book-keeping for one user-level push_pull spanning >=1 partitions.
+
+    Completion across partitions is tracked by the engine's ReadyTable
+    (keyed by handle), not here — this only holds the output assembly."""
 
     def __init__(self, handle: int, name: str, num_parts: int, out_shape, out_dtype,
                  postprocess: Optional[Callable] = None):
         self.handle = handle
         self.name = name
-        self.remaining = num_parts
         self.chunks: List[Optional[jax.Array]] = [None] * num_parts
         self.out_shape = out_shape
         self.out_dtype = out_dtype
@@ -74,6 +77,9 @@ class Engine:
             credit_bytes=cfg.effective_credit,
             name="push_pull",
         )
+        # Partition-completion barrier (reference ReadyTable role under
+        # SPMD, see common/ready_table.py): handle -> completed partitions.
+        self.ready = ReadyTable(name="push_pull_parts")
         self._completion_q: "queue_mod.Queue" = queue_mod.Queue()
         self._shutdown = threading.Event()
         self._dispatcher = threading.Thread(
@@ -113,16 +119,19 @@ class Engine:
         ctx = self.registry.declare(name)
         if priority == 0:
             priority = -ctx.declared_key  # reference tensorflow/ops.cc:158
+        if wire_dtype is None:
+            wire_dtype = cfg.wire_jnp_dtype
         out_shape = stacked.shape[1:]
         out_dtype = stacked.dtype
         flat = stacked.reshape(self.world, -1)
         nbytes_per_worker = flat.shape[1] * flat.dtype.itemsize
-        parts = partition_offsets(nbytes_per_worker, cfg.partition_bytes)
+        parts = partition_offsets(nbytes_per_worker, cfg.effective_partition_bytes)
         itemsize = flat.dtype.itemsize
 
         handle = self.handles.allocate()
         req = _PushPullRequest(handle, name, len(parts), out_shape, out_dtype,
                                postprocess)
+        self.ready.set_expected(handle, len(parts))
         counter = [len(parts)]
         for i, (off_b, len_b) in enumerate(parts):
             off_e, len_e = off_b // itemsize, len_b // itemsize
@@ -186,6 +195,7 @@ class Engine:
                 bps_log.error("dispatch failed for %s: %s", task.name, e)
                 req: _PushPullRequest = task.request  # type: ignore[attr-defined]
                 self.handles.mark_done(req.handle, Status.UnknownError(str(e)))
+                self.ready.clear_key(req.handle)  # no leak on failure
                 self.queue.report_finish(task)
 
     def _launch(self, task: TensorTaskEntry) -> jax.Array:
@@ -231,9 +241,9 @@ class Engine:
             req: _PushPullRequest = task.request  # type: ignore[attr-defined]
             with req.lock:
                 req.chunks[task.partition_index] = task.output
-                req.remaining -= 1
-                done = req.remaining == 0
+            done = self.ready.add_and_check(req.handle)
             if done:
+                self.ready.clear_key(req.handle)
                 if not status.ok():
                     self.handles.mark_done(req.handle, status)
                     continue

@@ -1,0 +1,287 @@
+"""TCP parameter-server tier — the ps-lite / MXNet-KVStore-server analog.
+
+The reference's inter-machine transport is ps-lite ``ZPush``/``ZPull`` over
+ZeroMQ/RDMA to CPU server processes that sum gradients (SURVEY.md §1;
+core_loops.cc:430-502 on the worker side, the bytedance MXNet server on the
+other end, launched by ``launcher/launch.py:62-64``).  The synchronous path
+does not need this tier on TPU (DCN collectives are strictly better), but
+the **asynchronous** mode is genuinely off the SPMD path and does: workers
+push weight deltas and pull global state at their own cadence, which is a
+client/server interaction, not a collective.
+
+This module provides that tier natively:
+
+  * ``serve()`` — a threaded TCP server owning one ``AsyncParameterServer``
+    shard; summation runs through the native OpenMP reducer when built.
+    Started by the launcher under ``DMLC_ROLE=server`` (the same role that
+    started the MXNet KVStore in the reference).
+  * ``RemoteStore`` — the worker-side client: same duck-typed interface as
+    the in-process stores (init_tensor/push_delta/pull/push_pull/version/
+    names), placing each tensor on a server with the reference's
+    key->server formula (global.cc:305-334).
+
+Wire protocol (binary, length-prefixed; one request per round-trip):
+
+    request :=  u8 op | u32 len(name) | name
+               | u32 len(dtype) | dtype-str | u8 ndim | u64*ndim shape
+               | u64 len(payload) | payload-bytes
+    reply   :=  u8 status | <tensor encoded as above, name "">
+
+Ops: 0=INIT (first-push-wins), 1=PUSH_PULL (atomic add+read),
+2=PULL, 3=VERSION (payload = u64), 4=NAMES (payload = '\n'.join),
+5=PING, 6=PUSH (delta add, status-only reply — no tensor download).
+No pickling — payloads are raw ``numpy`` buffers, like ps-lite's zero-copy
+char views.  Store-level errors come back as status=1 replies with the
+message in the payload; the connection survives.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+from ..common import logging as bps_log
+from ..common.context import name_key
+from .async_ps import AsyncParameterServer
+
+OP_INIT, OP_PUSH_PULL, OP_PULL, OP_VERSION, OP_NAMES, OP_PING, OP_PUSH = range(7)
+_MAX_NAME = 1 << 16
+_MAX_PAYLOAD = 1 << 34  # 16 GiB sanity bound
+
+
+# ---------------------------------------------------------------- wire codec
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(n - len(buf), 1 << 20))
+        if not chunk:
+            raise ConnectionError("peer closed mid-message")
+        buf += chunk
+    return bytes(buf)
+
+
+def _encode(op: int, name: str, arr: Optional[np.ndarray],
+            raw: bytes = b"") -> bytes:
+    nb = name.encode()
+    if arr is not None:
+        arr = np.ascontiguousarray(arr)
+        dt = arr.dtype.str.encode()
+        shape = arr.shape
+        payload = arr.tobytes()
+    else:
+        dt = b""
+        shape = ()
+        payload = raw
+    head = struct.pack("<BI", op, len(nb)) + nb
+    head += struct.pack("<I", len(dt)) + dt
+    head += struct.pack("<B", len(shape)) + struct.pack(
+        f"<{len(shape)}Q", *shape
+    )
+    head += struct.pack("<Q", len(payload))
+    return head + payload
+
+
+def _decode(sock: socket.socket):
+    op, nlen = struct.unpack("<BI", _recv_exact(sock, 5))
+    if nlen > _MAX_NAME:
+        raise ValueError(f"name too long: {nlen}")
+    name = _recv_exact(sock, nlen).decode()
+    (dlen,) = struct.unpack("<I", _recv_exact(sock, 4))
+    dt = _recv_exact(sock, dlen).decode()
+    (ndim,) = struct.unpack("<B", _recv_exact(sock, 1))
+    shape = struct.unpack(f"<{ndim}Q", _recv_exact(sock, 8 * ndim)) if ndim else ()
+    (plen,) = struct.unpack("<Q", _recv_exact(sock, 8))
+    if plen > _MAX_PAYLOAD:
+        raise ValueError(f"payload too large: {plen}")
+    payload = _recv_exact(sock, plen) if plen else b""
+    arr = None
+    if dt:
+        arr = np.frombuffer(payload, dtype=np.dtype(dt)).reshape(shape)
+    return op, name, arr, payload
+
+
+# -------------------------------------------------------------------- server
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):  # one connection, many requests
+        store: AsyncParameterServer = self.server.store  # type: ignore[attr-defined]
+        sock = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while True:
+                try:
+                    op, name, arr, _ = _decode(sock)
+                except ConnectionError:
+                    return
+                # store-level errors (e.g. pull of an un-init'd name) reply
+                # status=1 and keep the connection alive — only wire-level
+                # failures tear it down
+                try:
+                    if op == OP_INIT:
+                        store.init_tensor(name, arr)
+                        reply = _encode(0, "", None)
+                    elif op == OP_PUSH_PULL:
+                        reply = _encode(0, "", store.push_pull(name, arr))
+                    elif op == OP_PUSH:
+                        store.push_delta(name, arr)
+                        reply = _encode(0, "", None)
+                    elif op == OP_PULL:
+                        reply = _encode(0, "", store.pull(name))
+                    elif op == OP_VERSION:
+                        reply = _encode(0, "", None,
+                                        struct.pack("<Q", store.version(name)))
+                    elif op == OP_NAMES:
+                        reply = _encode(0, "", None,
+                                        "\n".join(store.names()).encode())
+                    elif op == OP_PING:
+                        reply = _encode(0, "", None)
+                    else:
+                        reply = _encode(1, "", None, f"bad op {op}".encode())
+                except Exception as e:
+                    reply = _encode(
+                        1, "", None, f"{type(e).__name__}: {e}".encode()
+                    )
+                sock.sendall(reply)
+        except Exception as e:  # pragma: no cover - connection teardown races
+            bps_log.debug("ps_server handler exit: %s", e)
+
+
+class PSServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, addr, use_native: bool = True):
+        super().__init__(addr, _Handler)
+        self.store = AsyncParameterServer(use_native=use_native)
+
+
+def serve(port: int, host: str = "0.0.0.0", use_native: bool = True,
+          in_thread: bool = False):
+    """Run one PS shard.  ``in_thread=True`` returns (server, thread) for
+    tests; otherwise blocks forever (the launcher's server role)."""
+    srv = PSServer((host, port), use_native=use_native)
+    bps_log.info("byteps_tpu PS server shard listening on %s:%d",
+                 host, srv.server_address[1])
+    if in_thread:
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        return srv, t
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:  # pragma: no cover
+        pass
+    finally:
+        srv.server_close()
+
+
+# -------------------------------------------------------------------- client
+
+
+class RemoteStore:
+    """Worker-side client over >=1 PS server shards.
+
+    Tensor -> server placement uses the declared-key formula of reference
+    global.cc:305-334 so a cluster's key distribution matches the
+    reference's load-balance behavior byte for byte.
+    """
+
+    def __init__(self, addrs: List[str], use_hash: bool = False,
+                 timeout: float = 30.0):
+        from ..common.context import ServerSharder
+
+        if not addrs:
+            raise ValueError("RemoteStore needs at least one server address")
+        self._addrs = list(addrs)
+        self._sharder = ServerSharder(len(addrs), use_hash=use_hash)
+        self._socks: List[Optional[socket.socket]] = [None] * len(addrs)
+        self._locks = [threading.Lock() for _ in addrs]
+        self._timeout = timeout
+
+    def _sock(self, i: int) -> socket.socket:
+        if self._socks[i] is None:
+            host, port = self._addrs[i].rsplit(":", 1)
+            s = socket.create_connection((host, int(port)),
+                                         timeout=self._timeout)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._socks[i] = s
+        return self._socks[i]
+
+    def _shard_of(self, name: str, nbytes: int = 0) -> int:
+        return self._sharder.place(name_key(name), nbytes)
+
+    def _rpc(self, shard: int, op: int, name: str,
+             arr: Optional[np.ndarray] = None, raw: bytes = b""):
+        with self._locks[shard]:
+            try:
+                sock = self._sock(shard)
+                sock.sendall(_encode(op, name, arr, raw))
+                status, _, out, payload = _decode(sock)
+            except (OSError, ConnectionError):
+                # drop the (possibly poisoned) cached socket so the next
+                # RPC reconnects instead of failing forever
+                if self._socks[shard] is not None:
+                    try:
+                        self._socks[shard].close()
+                    except OSError:
+                        pass
+                    self._socks[shard] = None
+                raise
+        if status != 0:
+            raise RuntimeError(f"ps_server error: {payload.decode()!r}")
+        return out, payload
+
+    # ------------------------------------------------- store interface
+
+    def init_tensor(self, name: str, value: np.ndarray) -> None:
+        self._rpc(self._shard_of(name), OP_INIT, name, np.asarray(value))
+
+    def push_delta(self, name: str, delta: np.ndarray) -> None:
+        d = np.asarray(delta)
+        # OP_PUSH replies status-only: no pointless global-tensor download
+        self._rpc(self._shard_of(name, d.nbytes), OP_PUSH, name, d)
+
+    def pull(self, name: str) -> np.ndarray:
+        out, _ = self._rpc(self._shard_of(name), OP_PULL, name)
+        return np.array(out)  # own the buffer
+
+    def push_pull(self, name: str, delta: np.ndarray) -> np.ndarray:
+        d = np.asarray(delta)
+        out, _ = self._rpc(self._shard_of(name, d.nbytes), OP_PUSH_PULL,
+                           name, d)
+        return np.array(out)
+
+    def version(self, name: str) -> int:
+        _, payload = self._rpc(self._shard_of(name), OP_VERSION, name)
+        return struct.unpack("<Q", payload)[0]
+
+    def names(self) -> List[str]:
+        out: List[str] = []
+        for i in range(len(self._addrs)):
+            _, payload = self._rpc(i, OP_NAMES, "")
+            if payload:
+                out.extend(payload.decode().split("\n"))
+        return out
+
+    def ping(self) -> bool:
+        try:
+            for i in range(len(self._addrs)):
+                self._rpc(i, OP_PING, "")
+            return True
+        except OSError:
+            return False
+
+    def close(self) -> None:
+        for i, s in enumerate(self._socks):
+            if s is not None:
+                try:
+                    s.close()
+                finally:
+                    self._socks[i] = None

@@ -12,9 +12,12 @@ The same env names keep working so reference run scripts port directly:
   DMLC_PS_ROOT_URI / DMLC_PS_ROOT_PORT  -> coordinator address
   DMLC_WORKER_ID                        -> process index
   DMLC_NUM_WORKER                       -> process count
-  DMLC_ROLE                             -> must be "worker" (server/scheduler
-                                           roles are accepted and exit 0 with
-                                           a notice — they are obsolete here)
+  DMLC_ROLE                             -> "worker" runs the command;
+                                           "server" + BYTEPS_ENABLE_ASYNC=1
+                                           runs a TCP PS shard
+                                           (engine/ps_server.py); otherwise
+                                           server/scheduler exit 0 with a
+                                           notice (sync mode needs no tier)
   BYTEPS_ENABLE_GDB=1                   -> wrap the command in gdb
                                            (launcher/launch.py:37-40)
 
@@ -57,8 +60,10 @@ def build_child_env(env: dict) -> dict:
         child["BYTEPS_NUM_PROCESSES"] = str(nproc)
         child["BYTEPS_PROCESS_ID"] = env.get("DMLC_WORKER_ID", "0")
         child["BYTEPS_DISTRIBUTED_INIT"] = "1"
+    # One process per host under SPMD, so local rank is 0; local *size* is
+    # deliberately NOT injected — api.local_size() reads the real device
+    # count of the process (the analog of the reference's GPU count).
     child.setdefault("BYTEPS_LOCAL_RANK", "0")
-    child.setdefault("BYTEPS_LOCAL_SIZE", "1")
     return child
 
 
@@ -67,13 +72,29 @@ def main(argv=None) -> int:
     env = dict(os.environ)
     env.setdefault("DMLC_ROLE", "worker")
     role = env["DMLC_ROLE"]
-    if role in ("server", "scheduler"):
-        # obsolete roles: the PS tier is replaced by XLA collectives / the
-        # in-process async-PS store (reference launch.py:62-64 started a
-        # whole MXNet KVStore here)
+    if role == "server":
+        if env.get("BYTEPS_ENABLE_ASYNC", "0") == "1":
+            # async-PS mode: this process becomes one PS shard (the analog
+            # of reference launch.py:62-64 starting the MXNet KVStore)
+            from .engine import ps_server
+
+            root = int(env.get("DMLC_PS_ROOT_PORT", "1234"))
+            server_id = int(env.get("DMLC_SERVER_ID", "0"))
+            port = int(env.get("BYTEPS_SERVER_PORT", str(root + 100 + server_id)))
+            ps_server.serve(port)
+            return 0
         print(
-            f"byteps_tpu.launcher: role '{role}' is not needed on TPU "
-            "(XLA collectives replace the parameter-server tier); exiting."
+            "byteps_tpu.launcher: role 'server' is only needed for async-PS "
+            "mode (BYTEPS_ENABLE_ASYNC=1); in sync mode XLA collectives "
+            "replace the parameter-server tier. Exiting."
+        )
+        return 0
+    if role == "scheduler":
+        # obsolete: JAX's coordination service (jax.distributed) replaces
+        # the DMLC scheduler rendezvous
+        print(
+            "byteps_tpu.launcher: role 'scheduler' is not needed on TPU "
+            "(jax.distributed replaces the DMLC scheduler); exiting."
         )
         return 0
     if not argv:

@@ -54,6 +54,7 @@ def build_mesh(
     devices: Optional[Sequence[jax.Device]] = None,
     mesh_shape: Optional[Dict[str, int]] = None,
     data_axis: str = "dp",
+    force_distributed: bool = False,
 ) -> Mesh:
     """Build the global mesh.
 
@@ -62,6 +63,13 @@ def build_mesh(
     attached (jax.process_count() > 1), putting the process dimension on the
     DCN axis so hierarchical reduction (ICI first, DCN second — the analog of
     BytePS's local-reduce-then-push, SURVEY.md §2.4) falls out of axis order.
+
+    ``force_distributed`` (env ``BYTEPS_FORCE_DISTRIBUTED``, reference
+    global.cc:109-112) exercises the distributed hierarchy on one machine:
+    the mesh gets a ``dcn`` axis of size 2 even single-process, so the
+    3-level reduction path runs exactly as it would across slices — the
+    reference uses the flag the same way, as the single-machine test
+    harness for the PS path (SURVEY.md §4).
 
     ``mesh_shape`` (or env ``BYTEPS_MESH_SHAPE``) overrides with arbitrary
     named axes; axis sizes must multiply to the device count.  Unspecified
@@ -99,6 +107,9 @@ def build_mesh(
         if nproc > 1 and n % nproc == 0 and n > nproc:
             shape["dcn"] = nproc
             shape[data_axis] = n // nproc
+        elif force_distributed and n % 2 == 0 and n > 1:
+            shape["dcn"] = 2
+            shape[data_axis] = n // 2
         else:
             shape[data_axis] = n
 

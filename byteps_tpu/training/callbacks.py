@@ -22,6 +22,7 @@ from typing import Callable, Dict, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 
@@ -107,20 +108,26 @@ def momentum_corrected_sgd(
 def average_metrics(metrics: Dict[str, Union[float, jax.Array]]) -> Dict[str, float]:
     """Average scalar metrics across workers at epoch end — the reference's
     ``MetricAverageCallback`` (_keras/callbacks.py:36-70, push_pull of
-    metric variables).  Uses the eager push_pull path; in single-process
-    runs with one logical worker this is the identity."""
-    import byteps_tpu as bps
+    metric variables).
 
-    n = bps.size()
-    out = {}
-    for k, v in metrics.items():
-        v = jnp.asarray(v, jnp.float32)
-        if n == 1:
-            out[k] = float(v)
-        else:
-            out[k] = float(bps.push_pull(jnp.broadcast_to(v, (n,)), average=True,
-                                         name=f"metric.{k}"))
-    return out
+    Multi-process runs average the *process-local* scalars across processes
+    (each host computed its metric from its own data shard); single-process
+    metrics are already global (the step program psums over the mesh), so
+    this is the identity there.
+    """
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        keys = sorted(metrics)
+        local = np.asarray(
+            [float(metrics[k]) for k in keys], dtype=np.float32
+        )
+        summed = multihost_utils.process_allgather(local).sum(axis=0)
+        return {
+            k: float(summed[i]) / jax.process_count()
+            for i, k in enumerate(keys)
+        }
+    return {k: float(jnp.asarray(v, jnp.float32)) for k, v in metrics.items()}
 
 
 class BroadcastGlobalVariablesCallback:

@@ -84,6 +84,16 @@ def restore_checkpoint(
                         "multi-host restore without a shared filesystem "
                         "requires template="
                     )
+                if not broadcast:
+                    # without the broadcast the template (fresh init) would
+                    # silently diverge from the root's restored state
+                    raise RuntimeError(
+                        f"checkpoint {path} not readable on process "
+                        f"{jax.process_index()} and broadcast=False: "
+                        "cannot fall back to the template without diverging "
+                        "from the root — pass broadcast=True or make the "
+                        "checkpoint readable on every host"
+                    )
                 restored = template
         if not broadcast:
             return restored

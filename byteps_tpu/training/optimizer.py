@@ -49,8 +49,12 @@ def push_pull_gradients(
     ``axis_name=None`` means single-worker: pass-through (the reference
     likewise short-circuits when size()==1).
     """
-    pb = partition_bytes or get_config().partition_bytes
+    cfg = get_config()
+    pb = partition_bytes or cfg.effective_partition_bytes
+    # compression class wins; else env BYTEPS_WIRE_DTYPE ("bf16"/"fp16")
     wire = getattr(compression, "wire_dtype", None)
+    if wire is None:
+        wire = cfg.wire_jnp_dtype
 
     def init_fn(params):
         del params

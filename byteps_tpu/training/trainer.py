@@ -27,7 +27,12 @@ from ..common import logging as bps_log
 from ..ops.compression import Compression
 from .callbacks import average_metrics
 from .checkpoint import CheckpointManager
-from .step import TrainState, make_data_parallel_step, shard_batch
+from .step import (
+    TrainState,
+    make_data_parallel_step,
+    replicate_state,
+    shard_batch,
+)
 
 
 class Trainer:
@@ -44,8 +49,14 @@ class Trainer:
         checkpoint_keep: int = 3,
         log_every: int = 100,
         callbacks: Sequence[Callable] = (),
+        async_mode: Optional[bool] = None,
+        async_store=None,
+        async_interval: int = 1,
+        worker_id: Optional[int] = None,
     ):
         bps.init()
+        from ..common.config import get_config
+
         self.mesh = mesh if mesh is not None else bps.mesh()
         self.step_fn = make_data_parallel_step(
             loss_fn, optimizer, self.mesh, axes=tuple(axes),
@@ -59,6 +70,25 @@ class Trainer:
         self.log_every = log_every
         self.callbacks = list(callbacks)
         self.state: Optional[TrainState] = None
+        # --- async-PS mode (reference BYTEPS_ENABLE_ASYNC,
+        # torch/__init__.py:174-189): intra-mesh reduction stays synchronous
+        # (the reference's intra-machine NCCL stage does too); *between*
+        # workers sharing a store, weight deltas are pushed and global state
+        # pulled with no barrier.  Flag precedence: explicit arg > env.
+        self.async_mode = (
+            get_config().enable_async if async_mode is None else async_mode
+        )
+        self.async_interval = max(1, async_interval)
+        self.worker_id = worker_id if worker_id is not None else bps.rank()
+        self._async_worker = None
+        if self.async_mode:
+            from ..engine.async_ps import get_async_store
+
+            self.async_store = (
+                async_store if async_store is not None else get_async_store()
+            )
+        else:
+            self.async_store = None
 
     # ------------------------------------------------------------------ api
 
@@ -66,16 +96,30 @@ class Trainer:
                    resume: bool = True) -> TrainState:
         """Broadcast-consistent init (reference BroadcastGlobalVariables
         semantics), optionally resuming from the latest checkpoint."""
+        state = None
         if self.ckpt is not None and resume:
             state = self.step_fn.init_state(params, model_state=model_state)
             restored, step = self.ckpt.restore_latest(template=tuple(state))
             if restored is not None:
                 bps_log.info("resuming from checkpoint step %d", step)
-                return TrainState(*restored)
-        params = bps.broadcast_parameters(params, root_rank=root_rank)
-        if model_state:
-            model_state = bps.broadcast_parameters(model_state, root_rank)
-        return self.step_fn.init_state(params, model_state=model_state)
+                state = TrainState(*restored)
+            else:
+                state = None
+        if state is None:
+            params = bps.broadcast_parameters(params, root_rank=root_rank)
+            if model_state:
+                model_state = bps.broadcast_parameters(model_state, root_rank)
+            state = self.step_fn.init_state(params, model_state=model_state)
+        if self.async_mode and self._async_worker is None:
+            from ..engine.async_ps import AsyncWorker
+
+            # registers + does the first-push-wins initial push (reference
+            # InitTensor's blocking initial push, operations.cc:262-284)
+            self._async_worker = AsyncWorker(
+                self.async_store, jax.device_get(state.params),
+                worker_id=self.worker_id,
+            )
+        return state
 
     def fit(
         self,
@@ -120,6 +164,16 @@ class Trainer:
                     # resync the host-side counter with the device counter
                     # so checkpoint step numbers stay consistent.
                     start_step = int(state.step) - seen
+            if self._async_worker is not None and seen % self.async_interval == 0:
+                # async-PS exchange: push this worker's weight delta, adopt
+                # the pulled global state (reference torch/__init__.py:
+                # 174-189 — params = pull(push(params - last_pulled)))
+                pulled = self._async_worker.push_pull(
+                    jax.device_get(state.params)
+                )
+                state = state._replace(
+                    params=replicate_state(pulled, self.mesh)
+                )
             if self.log_every and seen % self.log_every == 0:
                 avg = average_metrics(
                     {k: v for k, v in metrics.items()}

@@ -34,6 +34,27 @@ class TestLifecycle:
         assert bps.declare("Gradient.g0") == 0
 
 
+def test_force_distributed_builds_dcn_hierarchy(monkeypatch):
+    """BYTEPS_FORCE_DISTRIBUTED exercises the distributed (dcn) reduction
+    path on one machine (reference global.cc:109-112, SURVEY.md §4)."""
+    from byteps_tpu.common.config import reset_config
+
+    monkeypatch.setenv("BYTEPS_FORCE_DISTRIBUTED", "1")
+    reset_config()
+    try:
+        bps.init()
+        m = bps.mesh()
+        assert "dcn" in m.axis_names and int(m.shape["dcn"]) == 2
+        assert bps.size() == 8  # world size spans dcn x dp
+        x = np.tile(np.arange(8, dtype=np.float32)[:, None], (1, 16))
+        out = bps.push_pull(jnp.asarray(x), average=False, name="fd")
+        np.testing.assert_allclose(np.asarray(out), np.full((16,), 28.0))
+    finally:
+        bps.shutdown()
+        monkeypatch.delenv("BYTEPS_FORCE_DISTRIBUTED")
+        reset_config()
+
+
 class TestPushPull:
     def test_sum_contract(self, init8):
         # reference test_mxnet.py:76-113: result == sum over every rank's tensor

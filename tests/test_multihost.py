@@ -1,0 +1,99 @@
+"""Real >=2-process multi-host path test (VERDICT item 8).
+
+Launches two actual worker processes through ``byteps_tpu.launcher`` with
+the DMLC env contract on localhost; each bootstraps ``jax.distributed``
+(the replacement for the reference's ps::StartAsync + scheduler barrier,
+global.cc:197-212), builds the global mesh, and runs a cross-process
+push_pull — asserting the reference sum contract across process
+boundaries, not just the env translation.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+_WORKER = textwrap.dedent(
+    """
+    import numpy as np
+    import jax
+
+    # this image's sitecustomize registers the TPU plugin and overrides
+    # JAX_PLATFORMS via jax.config, so select CPU the same way (must happen
+    # before any backend-initializing call)
+    jax.config.update("jax_platforms", "cpu")
+
+    import byteps_tpu as bps
+
+    bps.init()  # BYTEPS_DISTRIBUTED_INIT=1 -> jax.distributed.initialize
+    assert jax.process_count() == 2, jax.process_count()
+    r = bps.rank()
+    n = bps.size()
+    assert n == 2, n
+
+    # cross-process sum: worker r contributes full((4,), r+1) => sum = 3
+    out = bps.push_pull(np.full((4,), float(r + 1), np.float32),
+                        average=False, name="xproc")
+    np.testing.assert_allclose(np.asarray(out), 3.0)
+
+    # average mode
+    out = bps.push_pull(np.full((4,), float(r + 1), np.float32),
+                        average=True, name="xproc_avg")
+    np.testing.assert_allclose(np.asarray(out), 1.5)
+
+    # broadcast_parameters: every process ends with the root's values
+    params = {"w": np.full((3,), float(r), np.float32)}
+    params = bps.broadcast_parameters(params, root_rank=0)
+    np.testing.assert_allclose(np.asarray(params["w"]), 0.0)
+
+    print(f"WORKER_{r}_OK")
+    bps.shutdown()
+    """
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_push_pull(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    port = _free_port()
+    procs = []
+    for wid in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)  # children get 1 real CPU device each
+        env.update(
+            JAX_PLATFORMS="cpu",
+            DMLC_ROLE="worker",
+            DMLC_NUM_WORKER="2",
+            DMLC_WORKER_ID=str(wid),
+            DMLC_PS_ROOT_URI="127.0.0.1",
+            DMLC_PS_ROOT_PORT=str(port),
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-m", "byteps_tpu.launcher",
+                 sys.executable, str(script)],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    outs = []
+    for wid, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail(f"worker {wid} timed out")
+        outs.append(out)
+    for wid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {wid} failed:\n{out}"
+        assert f"WORKER_{wid}_OK" in out, out

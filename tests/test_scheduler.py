@@ -103,6 +103,29 @@ class TestReadyTable:
         rt.add_ready_count(9)
         assert rt.is_key_ready(9)
 
+    def test_add_and_check_fires_exactly_once(self):
+        import threading
+
+        rt = ReadyTable()
+        rt.set_expected(7, 32)
+        fired = []
+        barrier = threading.Barrier(8)
+
+        def work():
+            barrier.wait()
+            for _ in range(4):
+                if rt.add_and_check(7):
+                    fired.append(1)
+
+        threads = [threading.Thread(target=work) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(fired) == 1  # exactly one completer observes completion
+        rt.clear_key(7)
+        assert not rt.is_key_ready(7)
+
 
 class TestRegistry:
     def test_monotonic_keys_and_idempotence(self):
