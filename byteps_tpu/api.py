@@ -57,6 +57,11 @@ def _maybe_distributed_init() -> None:
     # bootstrap work without the launcher's derived BYTEPS_* vars.
     cfg = get_config()
     addr = os.environ.get("BYTEPS_COORDINATOR_ADDR")
+    if addr is None and cfg.enable_async:
+        # async-PS workers talk to the server tier over TCP and need no
+        # collective bootstrap; DMLC_PS_ROOT_URI names the *server* host
+        # there, not a JAX coordinator — connecting would hang.
+        return
     if addr is None and os.environ.get("DMLC_PS_ROOT_URI"):
         addr = (
             os.environ["DMLC_PS_ROOT_URI"]
@@ -296,16 +301,14 @@ def _multihost_push_pull(tensor, average: bool, wire) -> int:
     mesh, axes = _state.mesh, tuple(_state.reduce_axes)
     local = np.asarray(tensor)
     # One worker == one *process* here (Horovod semantics).  The mesh's
-    # reduce axes span all devices, so the process's single contribution is
-    # replicated into its local_device_count slots pre-divided by that
-    # count: the mesh-wide sum then equals the sum over processes exactly,
-    # independent of host topology.
+    # reduce axes span all devices; the process's single contribution goes
+    # in its first local slot with zeros in the rest, so the mesh-wide sum
+    # equals the sum over processes exactly — for every dtype (no division,
+    # so integers stay integers) and independent of host topology.
     slots = jax.local_device_count()
-    if slots > 1:
-        local = local / slots
-    local = np.broadcast_to(local, (slots,) + local.shape).astype(
-        local.dtype, copy=False
-    )
+    local = np.concatenate(
+        [local[None], np.zeros((slots - 1,) + local.shape, local.dtype)]
+    ) if slots > 1 else local[None]
     sharding = NamedSharding(mesh, P(axes))
     stacked = jax.make_array_from_process_local_data(sharding, local)
     out = _collectives.push_pull_stacked(

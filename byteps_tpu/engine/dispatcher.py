@@ -58,6 +58,16 @@ class _PushPullRequest:
         self.out_dtype = out_dtype
         self.postprocess = postprocess
         self.lock = threading.Lock()
+        self.failed = False  # set (under lock) by the first failing partition
+
+    def mark_failed(self) -> bool:
+        """Record the first failure; returns True for exactly one caller so
+        the handle is marked done once."""
+        with self.lock:
+            if self.failed:
+                return False
+            self.failed = True
+            return True
 
 
 class Engine:
@@ -194,8 +204,14 @@ class Engine:
             except Exception as e:  # pragma: no cover
                 bps_log.error("dispatch failed for %s: %s", task.name, e)
                 req: _PushPullRequest = task.request  # type: ignore[attr-defined]
-                self.handles.mark_done(req.handle, Status.UnknownError(str(e)))
-                self.ready.clear_key(req.handle)  # no leak on failure
+                status = Status.UnknownError(str(e))
+                if req.mark_failed():
+                    self.handles.mark_done(req.handle, status)
+                # the failed partition still counts toward the barrier so the
+                # key is cleared exactly when the last sibling lands (no leak,
+                # no early-fire with the default expectation)
+                if self.ready.add_and_check(req.handle):
+                    self.ready.clear_key(req.handle)
                 self.queue.report_finish(task)
 
     def _launch(self, task: TensorTaskEntry) -> jax.Array:
@@ -241,12 +257,15 @@ class Engine:
             req: _PushPullRequest = task.request  # type: ignore[attr-defined]
             with req.lock:
                 req.chunks[task.partition_index] = task.output
+            if not status.ok() and req.mark_failed():
+                self.handles.mark_done(req.handle, status)
             done = self.ready.add_and_check(req.handle)
             if done:
                 self.ready.clear_key(req.handle)
-                if not status.ok():
-                    self.handles.mark_done(req.handle, status)
-                    continue
+                with req.lock:
+                    failed = req.failed
+                if failed:
+                    continue  # handle already marked by the first failure
                 chunks = [c for c in req.chunks if c is not None]
                 out = chunks[0] if len(chunks) == 1 else jnp.concatenate(chunks)
                 out = out.reshape(req.out_shape).astype(req.out_dtype)

@@ -57,6 +57,21 @@ _MAX_PAYLOAD = 1 << 34  # 16 GiB sanity bound
 # ---------------------------------------------------------------- wire codec
 
 
+def _dtype_to_wire(dt: np.dtype) -> bytes:
+    """Encode a dtype by *name* (e.g. ``bfloat16``): ml_dtypes dtypes have
+    ``.str`` of ``'<V2'`` (raw void) which would not round-trip."""
+    return np.dtype(dt).name.encode()
+
+
+def _wire_to_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
     buf = bytearray()
     while len(buf) < n:
@@ -72,7 +87,7 @@ def _encode(op: int, name: str, arr: Optional[np.ndarray],
     nb = name.encode()
     if arr is not None:
         arr = np.ascontiguousarray(arr)
-        dt = arr.dtype.str.encode()
+        dt = _dtype_to_wire(arr.dtype)
         shape = arr.shape
         payload = arr.tobytes()
     else:
@@ -103,7 +118,7 @@ def _decode(sock: socket.socket):
     payload = _recv_exact(sock, plen) if plen else b""
     arr = None
     if dt:
-        arr = np.frombuffer(payload, dtype=np.dtype(dt)).reshape(shape)
+        arr = np.frombuffer(payload, dtype=_wire_to_dtype(dt)).reshape(shape)
     return op, name, arr, payload
 
 

@@ -68,19 +68,20 @@ class TransformerConfig:
         causal = self.causal
         names = set(self.mesh.axis_names) if self.mesh is not None else set()
         has_sp = self.sp_axis in names and self.mesh.shape[self.sp_axis] > 1
-        if self.attn_impl == "flash":
-            if has_sp:
-                raise ValueError(
-                    "attn_impl='flash' is a single-shard kernel; with a "
-                    "sequence-parallel (sp) mesh axis use 'ring' or "
-                    "'ulysses' instead"
-                )
+        if self.attn_impl == "flash" and not has_sp:
             from ..ops.flash_attention import flash_attention
 
             return lambda q, k, v: flash_attention(q, k, v, causal=causal)
         if self.attn_impl == "local" or self.mesh is None:
             return lambda q, k, v: local_attention(q, k, v, causal=causal)
-        inner = ring_attention if self.attn_impl == "ring" else ulysses_attention
+        if self.attn_impl == "flash":
+            # flash (x) sp: ring schedule with the Pallas kernel per block
+            from ..parallel.ring_attention import ring_flash_attention
+
+            inner = ring_flash_attention
+        else:
+            inner = (ring_attention if self.attn_impl == "ring"
+                     else ulysses_attention)
         if self.sp_axis not in names:
             return lambda q, k, v: local_attention(q, k, v, causal=causal)
         spec = P(

@@ -1,4 +1,4 @@
-"""Pallas TPU flash attention (forward kernel).
+"""Pallas TPU flash attention (forward + backward kernels).
 
 The hot op of the transformer stack, written for the MXU/VMEM rather than
 translated from any CUDA kernel: the grid walks (batch*heads, query blocks),
@@ -7,12 +7,21 @@ accumulates one key block at a time — no [T, T] score matrix ever
 materializes in HBM.  Causal masking prunes the loop to the lower-triangle
 blocks (the bubble work is skipped, not masked).
 
-Backward uses a custom_vjp whose residuals are just (q, k, v, o, lse): a
-``lax.scan`` over key blocks recomputes a ``[T, block_k]`` score slice at a
-time with standard XLA ops, so backward peak memory is O(T * block_k) like
-the forward (no [T, T] matrix ever materializes).  Combined with
-``parallel/ring_attention.py`` (which shards T across chips) this covers
-both the single-chip memory story and the multi-chip long-context story.
+Backward is a custom_vjp with residuals (q, k, v, o, lse) and **two Pallas
+kernels** (the standard flash-attention-2 split, designed for the MXU's
+preference for large stationary operands over atomics):
+
+  * ``_bwd_dq_kernel`` — grid (batch*heads, q blocks): recomputes one
+    [BQ, BK] score slice at a time and accumulates dq for its q block;
+  * ``_bwd_dkv_kernel`` — grid (batch*heads, k blocks): walks q blocks
+    (causal pruning skips the upper triangle) and accumulates dk/dv for
+    its k block.
+
+Peak memory stays O(T * block) like the forward.  Combined with
+``parallel/ring_attention.py`` (which shards T across chips and calls this
+kernel per ring block — ``attn_impl="flash"`` composes with the ``sp``
+axis) this covers both the single-chip memory story and the multi-chip
+long-context story.
 
 Layout convention matches the rest of the stack: ``[B, T, H, D]``.
 ``D`` should be a multiple of the 128-lane width for full MXU utilization
@@ -32,6 +41,14 @@ from jax.experimental import pallas as pl
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 _NEG_INF = -1e30
+
+
+def _resolve_interpret(interpret) -> bool:
+    """None = auto: interpret mode off TPU (CPU tests / virtual meshes),
+    compiled Mosaic kernels on TPU."""
+    if interpret is None:
+        return jax.default_backend() != "tpu"
+    return bool(interpret)
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
@@ -87,6 +104,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
 
 
 def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+    interpret = _resolve_interpret(interpret)
     B, T, H, D = q.shape
     bq = min(block_q, T)
     bk = min(block_k, T)
@@ -132,7 +150,7 @@ def flash_attention(
     scale: Optional[float] = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
-    interpret: bool = False,
+    interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Exact attention, O(T) memory forward.  q/k/v: ``[B, T, H, D]``."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
@@ -147,56 +165,177 @@ def _fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
     return o, (q, k, v, o, lse)
 
 
-def _bwd_rule(causal, scale, block_q, block_k, interpret, res, do):
-    """Blockwise backward: lax.scan over key blocks so only a [T, BK] score
-    slice is ever live — the O(T * BK) memory analog of the forward kernel
-    (no [T, T] matrix materializes)."""
-    q, k, v, o, lse = res
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, block_k: int, causal: bool, scale: float):
+    """dq for one q block: loop over k blocks, recompute the [BQ, BK] score
+    slice, accumulate dq = scale * sum_j ds_j @ k_j."""
+    qi = pl.program_id(1)
+    block_q = q_ref.shape[1]
+    T = k_ref.shape[1]
+    nk = T // block_k
+
+    q = q_ref[0].astype(jnp.float32) * scale      # [BQ, D]
+    do = do_ref[0].astype(jnp.float32)            # [BQ, D]
+    lse = lse_ref[0].astype(jnp.float32)          # [BQ, 1]
+    delta = delta_ref[0].astype(jnp.float32)      # [BQ, 1]
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [BQ, BK]
+        if causal:
+            row = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            col = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(row >= col, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [BQ, BK]
+        ds = p * (dp - delta)
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    n_iter = (
+        jnp.minimum(nk, (qi * block_q + block_q + block_k - 1) // block_k)
+        if causal else nk
+    )
+    dq = lax.fori_loop(0, n_iter, body,
+                       jnp.zeros((block_q, q_ref.shape[2]), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, block_q: int, causal: bool,
+                    scale: float):
+    """dk/dv for one k block: loop over q blocks (causal pruning starts at
+    the diagonal), accumulate dv = sum_i p_i^T @ do_i and
+    dk = scale * sum_i ds_i^T @ q_i."""
+    ki = pl.program_id(1)
+    block_k = k_ref.shape[1]
+    T = q_ref.shape[1]
+    D = q_ref.shape[2]
+    nq = T // block_q
+
+    k = k_ref[0].astype(jnp.float32)              # [BK, D]
+    v = v_ref[0].astype(jnp.float32)              # [BK, D]
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32) * scale
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        delta = delta_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [BQ, BK]
+        if causal:
+            row = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            col = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(row >= col, s, _NEG_INF)
+        p = jnp.exp(s - lse)                       # [BQ, BK]
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [BK, D]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [BQ, BK]
+        ds = p * (dp - delta)
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [BK, D]
+        return dk, dv
+
+    # causal: q blocks before the diagonal see only masked scores — skip
+    start = (ki * block_k) // block_q if causal else 0
+    dk, dv = lax.fori_loop(
+        start, nq, body,
+        (jnp.zeros((block_k, D), jnp.float32),
+         jnp.zeros((block_k, D), jnp.float32)),
+    )
+    # q was pre-scaled inside body, so dk = sum ds^T @ (scale*q) is already
+    # the full dL/dk — no extra scale factor
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, do, dlse, causal, scale, block_q,
+                    block_k, interpret):
+    """Shared Pallas backward.  ``dlse`` (``[BH, T, 1]`` or None) is the
+    cotangent of the log-sum-exp output: since d(lse)/d(s) = softmax(s),
+    it folds into the kernels as ``ds = p * (dp - (delta - dlse))`` — the
+    same two kernels serve both ``flash_attention`` and the
+    lse-returning variant ring attention differentiates through."""
+    interpret = _resolve_interpret(interpret)
     B, T, H, D = q.shape
     scale = scale if scale is not None else D ** -0.5
+    bq = min(block_q, T)
     bk = min(block_k, T)
-    nk = T // bk
 
     # fold batch & heads: [B, T, H, D] -> [BH, T, D]
     def fold(x):
         return x.transpose(0, 2, 1, 3).reshape(B * H, T, x.shape[-1])
 
-    qf = fold(q).astype(jnp.float32) * scale
-    kf = fold(k).astype(jnp.float32)
-    vf = fold(v).astype(jnp.float32)
-    dof = fold(do).astype(jnp.float32)
-    of = fold(o).astype(jnp.float32)
-    lse_f = lse  # already [BH, T]
-    delta = jnp.sum(dof * of, axis=-1)  # [BH, T]
+    qf, kf, vf, dof = fold(q), fold(k), fold(v), fold(do)
+    # delta = rowsum(do * o), the softmax-jacobian correction term
+    delta = jnp.sum(fold(do).astype(jnp.float32) * fold(o).astype(jnp.float32),
+                    axis=-1, keepdims=True)          # [BH, T, 1]
+    if dlse is not None:
+        delta = delta - dlse
+    lse3 = lse[..., None]                            # [BH, T, 1]
 
-    pos_q = jnp.arange(T)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block_k=bk, causal=causal,
+                          scale=scale),
+        grid=(B * H, T // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),   # q block
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),    # k
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),    # v
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),   # do block
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),   # lse block
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),   # delta block
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse3, delta)
 
-    def body(dq_acc, j):
-        kj = lax.dynamic_slice_in_dim(kf, j * bk, bk, axis=1)  # [BH,BK,D]
-        vj = lax.dynamic_slice_in_dim(vf, j * bk, bk, axis=1)
-        s = jnp.einsum("btd,bkd->btk", qf, kj,
-                       preferred_element_type=jnp.float32)  # [BH,T,BK]
-        if causal:
-            col = j * bk + jnp.arange(bk)
-            s = jnp.where(pos_q[:, None] >= col[None, :], s, _NEG_INF)
-        p = jnp.exp(s - lse_f[..., None])
-        dv_j = jnp.einsum("btk,btd->bkd", p, dof,
-                          preferred_element_type=jnp.float32)
-        dp = jnp.einsum("btd,bkd->btk", dof, vj,
-                        preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[..., None])
-        dq_acc = dq_acc + jnp.einsum("btk,bkd->btd", ds, kj,
-                                     preferred_element_type=jnp.float32)
-        dk_j = jnp.einsum("btk,btd->bkd", ds, qf,
-                          preferred_element_type=jnp.float32)
-        return dq_acc, (dk_j, dv_j)
-
-    dq0 = jnp.zeros_like(qf)
-    dq, (dk_blocks, dv_blocks) = lax.scan(body, dq0, jnp.arange(nk))
-    dq = dq * scale
-    # [nk, BH, BK, D] -> [BH, T, D]
-    dk = dk_blocks.transpose(1, 0, 2, 3).reshape(B * H, T, D)
-    dv = dv_blocks.transpose(1, 0, 2, 3).reshape(B * H, T, D)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, block_q=bq, causal=causal,
+                          scale=scale),
+        grid=(B * H, T // bk),
+        in_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),   # k block
+            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),   # v block
+            pl.BlockSpec((1, T, D), lambda b, j: (b, 0, 0)),    # q
+            pl.BlockSpec((1, T, D), lambda b, j: (b, 0, 0)),    # do
+            pl.BlockSpec((1, T, 1), lambda b, j: (b, 0, 0)),    # lse
+            pl.BlockSpec((1, T, 1), lambda b, j: (b, 0, 0)),    # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, T, D), v.dtype),
+        ],
+        interpret=interpret,
+    )(kf, vf, qf, dof, lse3, delta)
 
     def unfold(x, dtype):
         return x.reshape(B, H, T, D).transpose(0, 2, 1, 3).astype(dtype)
@@ -204,4 +343,64 @@ def _bwd_rule(causal, scale, block_q, block_k, interpret, res, do):
     return unfold(dq, q.dtype), unfold(dk, k.dtype), unfold(dv, v.dtype)
 
 
+def _bwd_rule(causal, scale, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    return _flash_backward(q, k, v, o, lse, do, None, causal, scale,
+                           block_q, block_k, interpret)
+
+
 flash_attention.defvjp(_fwd_rule, _bwd_rule)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: Optional[bool] = None,
+):
+    """Forward returning ``(o, lse)`` with ``lse: [B, T, H]`` — the
+    combinable form ring attention needs to fold per-ring-step block
+    results (see parallel/ring_attention.py).  Fully differentiable in
+    both outputs: the lse cotangent folds into the same Pallas backward
+    kernels (see _flash_backward)."""
+    o, lse, _ = _lse_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return o, lse
+
+
+def _lse_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    scale_v = scale if scale is not None else q.shape[-1] ** -0.5
+    o, lse_bh = _flash_forward(q, k, v, causal, scale_v, block_q, block_k,
+                               interpret)
+    B, T, H, D = q.shape
+    lse = lse_bh.reshape(B, H, T).transpose(0, 2, 1)  # [B, T, H]
+    return o, lse, lse_bh
+
+
+def _lse_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, lse, lse_bh = _lse_fwd(q, k, v, causal, scale, block_q, block_k,
+                              interpret)
+    return (o, lse), (q, k, v, o, lse_bh)
+
+
+def _lse_bwd_rule(causal, scale, block_q, block_k, interpret, res, cts):
+    q, k, v, o, lse_bh = res
+    do, dlse = cts
+    B, T, H, D = q.shape
+    if do is None or getattr(do, "dtype", None) == jax.dtypes.float0:
+        do = jnp.zeros_like(o)
+    if dlse is None or getattr(dlse, "dtype", None) == jax.dtypes.float0:
+        dlse3 = None
+    else:
+        # [B, T, H] -> [BH, T, 1]
+        dlse3 = dlse.transpose(0, 2, 1).reshape(B * H, T)[..., None]
+        dlse3 = dlse3.astype(jnp.float32)
+    return _flash_backward(q, k, v, o, lse_bh, do, dlse3, causal, scale,
+                           block_q, block_k, interpret)
+
+
+flash_attention_with_lse.defvjp(_lse_fwd_rule, _lse_bwd_rule)

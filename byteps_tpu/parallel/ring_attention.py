@@ -109,6 +109,73 @@ def ring_attention(
     return out.astype(q.dtype)
 
 
+def ring_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Ring attention whose per-block compute is the Pallas flash kernel —
+    ``attn_impl="flash"`` composed with the ``sp`` axis.
+
+    Same ring schedule as :func:`ring_attention` (K/V rotate by ``ppermute``,
+    one ICI hop per step), but each held block is attended with
+    ``flash_attention_with_lse`` (MXU kernel, O(T_local) memory) and the
+    per-block normalized results are folded with log-sum-exp weights:
+
+        lse' = logaddexp(lse, lse_blk)
+        o'   = o * e^(lse-lse') + o_blk * e^(lse_blk-lse')
+
+    Causality: past blocks attend fully, the diagonal block runs the causal
+    kernel (local positions == global on the diagonal), future blocks are
+    nulled at the combine (lse_blk = -inf).  Differentiable end to end —
+    the lse cotangent of the combine flows into the flash backward kernels
+    (ops/flash_attention.py::_flash_backward).
+    """
+    from ..ops.flash_attention import flash_attention_with_lse
+
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    B, T, H, D = q.shape
+    scale = scale if scale is not None else D ** -0.5
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    o0 = jnp.zeros((B, T, H, D), jnp.float32)
+    lse0 = jnp.full((B, T, H), -jnp.inf, jnp.float32)
+
+    def body(carry, step):
+        o, lse, kc, vc = carry
+        src = (my - step) % n  # whose K/V block we hold this step
+        if causal:
+            o_blk, lse_blk = lax.cond(
+                src == my,
+                lambda: flash_attention_with_lse(
+                    q, kc, vc, True, scale, block_q, block_k, interpret),
+                lambda: flash_attention_with_lse(
+                    q, kc, vc, False, scale, block_q, block_k, interpret),
+            )
+            # block-level causality: strictly-future blocks contribute 0
+            lse_blk = jnp.where(src <= my, lse_blk, -jnp.inf)
+        else:
+            o_blk, lse_blk = flash_attention_with_lse(
+                q, kc, vc, False, scale, block_q, block_k, interpret)
+        lse_new = jnp.logaddexp(lse, lse_blk)
+        w_old = jnp.nan_to_num(jnp.exp(lse - lse_new))
+        w_blk = jnp.nan_to_num(jnp.exp(lse_blk - lse_new))
+        o = o * w_old[..., None] + o_blk.astype(jnp.float32) * w_blk[..., None]
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return (o, lse_new, kc, vc), None
+
+    (o, _, _, _), _ = lax.scan(body, (o0, lse0, k, v), jnp.arange(n))
+    return o.astype(q.dtype)
+
+
 def ulysses_attention(
     q: jax.Array,
     k: jax.Array,

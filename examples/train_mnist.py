@@ -47,6 +47,11 @@ def main():
     p.add_argument("--steps", type=int, default=200)
     p.add_argument("--batch-size", type=int, default=512)
     p.add_argument("--lr", type=float, default=0.01)
+    p.add_argument(
+        "--overlap", action="store_true",
+        help="cross-iteration comm/compute overlap (delayed gradients — "
+             "the ByteScheduler mode; see byteps_tpu/training/overlap.py)",
+    )
     args = p.parse_args()
 
     bps.init()
@@ -66,7 +71,12 @@ def main():
 
     sched = warmup_schedule(args.lr, bps.size(), warmup_steps=50)
     tx = optax.sgd(sched, momentum=0.9)
-    step = make_data_parallel_step(mlp_loss_fn, tx, mesh)
+    if args.overlap:
+        from byteps_tpu.training.overlap import make_delayed_grad_step
+
+        step = make_delayed_grad_step(mlp_loss_fn, tx, mesh)
+    else:
+        step = make_data_parallel_step(mlp_loss_fn, tx, mesh)
     state = step.init_state(params)
 
     images, labels = synthetic_mnist(jax.random.PRNGKey(1))
@@ -82,6 +92,8 @@ def main():
         state, metrics = step(state, batch)
         if i % 50 == 0 or i == args.steps - 1:
             print(f"step {i:4d} loss {float(metrics['loss']):.4f}")
+    if args.overlap:
+        state = step.flush(state)  # apply the final pending gradients
     dt = time.time() - t0
     print(f"done: {args.steps} steps in {dt:.1f}s "
           f"({args.steps * args.batch_size / dt:.0f} samples/s)")

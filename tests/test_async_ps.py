@@ -170,6 +170,18 @@ def test_ps_server_end_to_end():
         np.testing.assert_allclose(got["b"], 4.0)
         assert c1.version("param_0") == 2
         assert set(c1.names()) == {"param_0", "param_1"}
+        # bf16 round-trips by dtype *name* (.str is raw-void for ml_dtypes)
+        import ml_dtypes
+
+        bf = np.zeros(16, ml_dtypes.bfloat16)
+        c1.init_tensor("bf", bf)
+        out = c1.push_pull("bf", np.ones(16, ml_dtypes.bfloat16))
+        assert out.dtype == np.dtype(ml_dtypes.bfloat16)
+        np.testing.assert_allclose(out.astype(np.float32), 1.0)
+        # store-level error -> status-1 reply, connection survives
+        with pytest.raises(RuntimeError, match="ps_server error"):
+            c1.pull("never_declared")
+        assert c1.ping()
         c1.close(); c2.close()
     finally:
         srv1.shutdown(); srv2.shutdown()
