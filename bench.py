@@ -1,102 +1,117 @@
-"""Benchmark: ResNet50 fp32, batch 64/chip — the reference's headline config
-(SURVEY.md §6: "ResNet50 fp32 (batch 64/GPU) images/sec"; BASELINE.json
-configs[1]).
+"""Benchmark matrix — the reference's headline configs (BASELINE.json /
+SURVEY.md §6), rendered for TPU:
 
-Measures images/sec of the framework's full data-parallel train step
-(scheduled bucketed push_pull + BatchNorm state + SGD-momentum) on the
-available chip(s), and compares against a plain hand-written jax step on the
-same model — the "Horovod analog" of SURVEY.md §7 (no scheduling layer).
-``vs_baseline`` = framework / plain: >= 1.0 means the scheduling layer costs
-nothing (single chip) or wins (multi chip, comm overlap).
+  * resnet50 fp32, batch 64/chip  (reference "ResNet50 fp32 (batch 64/GPU)")
+  * resnet50 bf16, batch 64/chip  (TPU-native dtype of the same model)
+  * vgg16   fp32, batch 64/chip   (the comm-bound north-star config,
+                                   reference README.md:22-26)
+  * bert-base fine-tune, bf16     (BASELINE.json configs[3])
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": R}
+Each config measures the framework's full data-parallel train step
+(scheduled bucketed push_pull + optimizer) against a plain hand-written
+jax step on the same model — the "Horovod analog" of SURVEY.md §7 (no
+scheduling layer).  ``vs_baseline`` = framework / plain: >= 1.0 means the
+scheduling layer costs nothing (single chip) or wins (multi chip, comm
+overlap).  ``mfu`` is model FLOPs (XLA cost analysis of the compiled
+program, falling back to analytic counts) / wall time / chip peak.
+
+Prints ONE JSON line per config; the LAST line is the headline ResNet50
+fp32 config (same metric name as round 1) and additionally carries the
+whole matrix under "configs".
 """
 
 from __future__ import annotations
 
 import json
-import sys
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax.sharding import Mesh
+from jax.sharding import Mesh, PartitionSpec as P
 
-from byteps_tpu.models import ResNet50
+from byteps_tpu.common.timing import readback_barrier
+from byteps_tpu.models import ResNet50, VGG16
+from byteps_tpu.models.bert import BertClassifier, bert_config
+from byteps_tpu.parallel.collectives import shard_map
 from byteps_tpu.training import (
     classification_loss_fn,
     make_data_parallel_step,
     shard_batch,
 )
+from byteps_tpu.training.step import replicate_state
 
 WARMUP = 5
 ITERS = 30
 
+# bf16 MXU peak per chip (TFLOP/s), keyed by substring of device_kind.
+# Sources: public TPU spec sheets; used only for the MFU denominator.
+_PEAK_TFLOPS = [
+    ("v6", 918.0),  # Trillium
+    ("v5p", 459.0),
+    ("v5", 197.0),  # v5e / "TPU v5 lite"
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 46.0),
+]
 
-from byteps_tpu.common.timing import readback_barrier as _readback_barrier
+
+def _chip_peak_flops() -> float | None:
+    kind = jax.devices()[0].device_kind.lower()
+    for sub, tf in _PEAK_TFLOPS:
+        if sub in kind:
+            return tf * 1e12
+    return None
 
 
-def _time_steps(fn, state, batch, iters):
-    # warmup (includes compile)
-    for _ in range(WARMUP):
-        state, metrics = fn(state, batch)
-    _readback_barrier(metrics, state)
+def _aot_compile(jitted_fn, *args):
+    """AOT-compile the step once; the compiled object serves both the
+    timing loop and XLA cost analysis (avoids a second trace+compile)."""
+    compiled = jitted_fn.lower(*args).compile()
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", -1.0))
+        flops = flops if flops > 0 else None
+    except Exception:
+        flops = None
+    return compiled, flops
+
+
+def _time_chunk(fn, state, batch, iters):
+    """One timed chunk ended by a value-readback barrier
+    (block_until_ready lies on the tunneled TPU runtime; see
+    common/timing.py).  Returns (sec/step, new_state)."""
     t0 = time.perf_counter()
     for _ in range(iters):
         state, metrics = fn(state, batch)
-    # true completion barrier: value readback (block_until_ready lies on
-    # the tunneled TPU runtime; see common/timing.py)
-    _readback_barrier(metrics, state)
-    return (time.perf_counter() - t0) / iters
+    readback_barrier(metrics, state)
+    return (time.perf_counter() - t0) / iters, state
 
 
-def main():
-    on_tpu = jax.devices()[0].platform == "tpu"
-    n_dev = len(jax.devices())
-    if on_tpu:
-        batch_per_chip, hw, classes, filters = 64, 224, 1000, 64
-    else:  # CPU smoke mode so the script stays runnable anywhere
-        batch_per_chip, hw, classes, filters = 4, 32, 10, 8
+def _time_pair(fn_a, state_a, fn_b, state_b, batch, iters=ITERS, repeats=3):
+    """Time two programs on the same inputs with *interleaved* best-of-N
+    chunks: alternating a/b chunks cancels slow drift (chip clocks, tunnel
+    warm-up) that back-to-back timing folds into whichever runs second;
+    min is the noise-robust estimator for a deterministic program."""
+    for _ in range(WARMUP):
+        state_a, ma = fn_a(state_a, batch)
+        state_b, mb = fn_b(state_b, batch)
+    readback_barrier(ma, mb)
+    best_a = best_b = float("inf")
+    for _ in range(repeats):
+        dt, state_a = _time_chunk(fn_a, state_a, batch, iters)
+        best_a = min(best_a, dt)
+        dt, state_b = _time_chunk(fn_b, state_b, batch, iters)
+        best_b = min(best_b, dt)
+    return best_a, best_b
 
-    batch_size = batch_per_chip * n_dev
-    mesh = Mesh(np.array(jax.devices()), ("dp",))
-    model = ResNet50(num_classes=classes, num_filters=filters, dtype=jnp.float32)
 
-    rng = jax.random.PRNGKey(0)
-    x0 = jnp.zeros((batch_per_chip, hw, hw, 3), jnp.float32)
-    variables = model.init(rng, x0, train=False)
-    params, bstats = variables["params"], variables["batch_stats"]
-
-    images = jax.random.normal(jax.random.PRNGKey(1), (batch_size, hw, hw, 3))
-    labels = jax.random.randint(jax.random.PRNGKey(2), (batch_size,), 0, classes)
-    batch = shard_batch({"image": images, "label": labels}, mesh)
-
-    tx = optax.sgd(0.1, momentum=0.9)
-    loss_fn = classification_loss_fn(model)
-
-    # --- framework step (scheduled bucketed push_pull)
-    step = make_data_parallel_step(loss_fn, tx, mesh)
-    state = step.init_state(params, model_state={"batch_stats": bstats})
-    # build the baseline state BEFORE timing: the framework step donates its
-    # input buffers, so params/bstats must be materialized for both first
-    from byteps_tpu.training.step import replicate_state
-
-    pstate = replicate_state(
-        jax.tree_util.tree_map(
-            lambda x: jnp.array(x, copy=True),
-            (params, tx.init(params), {"batch_stats": bstats}),
-        ),
-        mesh,
-    )
-    t_fw = _time_steps(step, state, batch, ITERS)
-
-    # --- plain-jax baseline: same model/optimizer, naive jax.grad + psum-free
-    #     single-program step (the no-scheduler Horovod analog)
-    from byteps_tpu.parallel.collectives import shard_map
-    from jax.sharding import PartitionSpec as P
+def _make_plain_step(loss_fn, tx, mesh):
+    """The no-scheduler Horovod analog: naive jax.grad + pmean in one SPMD
+    program, same model/optimizer/batch layout."""
 
     def plain_local(state, batch):
         params, opt_state, mstate = state
@@ -105,9 +120,7 @@ def main():
             return loss_fn(p, mstate, batch)
 
         (loss, new_mstate), grads = jax.value_and_grad(lf, has_aux=True)(params)
-        grads = jax.tree_util.tree_map(
-            lambda g: jax.lax.pmean(g, "dp"), grads
-        )
+        grads = jax.tree_util.tree_map(lambda g: jax.lax.pmean(g, "dp"), grads)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         new_mstate = jax.tree_util.tree_map(
@@ -117,32 +130,148 @@ def main():
         )
         return (params, opt_state, new_mstate), jax.lax.pmean(loss, "dp")
 
-    plain = jax.jit(
-        shard_map(
-            plain_local, mesh, in_specs=(P(), P("dp")), out_specs=(P(), P())
-        ),
+    jitted = jax.jit(
+        shard_map(plain_local, mesh, in_specs=(P(), P("dp")),
+                  out_specs=(P(), P())),
         donate_argnums=(0,),
     )
 
-    def plain_fn(state, batch):
-        state, loss = plain(state, batch)
-        return state, {"loss": loss}
+    return jitted
 
-    t_plain = _time_steps(plain_fn, pstate, batch, ITERS)
 
-    ips = batch_size / t_fw
-    ips_plain = batch_size / t_plain
-    print(
-        json.dumps(
-            {
-                "metric": f"resnet50_fp32_b{batch_per_chip}_images_per_sec"
-                + ("" if on_tpu else "_cpusmoke"),
-                "value": round(ips, 2),
-                "unit": "images/sec",
-                "vs_baseline": round(ips / ips_plain, 4),
-            }
-        )
+def _deep_copy(tree):
+    return jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True), tree)
+
+
+def _run_config(name, unit, per_item_scale, model, loss_fn, tx, mesh, batch,
+                batch_size, analytic_flops_per_item, init_args, init_kwargs):
+    """Build framework + plain states, time both, return the result dict.
+
+    ``per_item_scale`` converts items/step (batch rows) to the reported
+    unit (1 for images, seq_len for tokens).
+    """
+    variables = model.init(jax.random.PRNGKey(0), *init_args, **init_kwargs)
+    params = variables["params"]
+    mstate = {k: v for k, v in variables.items() if k != "params"}
+
+    step = make_data_parallel_step(loss_fn, tx, mesh)
+    state = step.init_state(_deep_copy(params), model_state=_deep_copy(mstate))
+    compiled_fw, flops = _aot_compile(step._fn, state, batch)
+    if flops is None and analytic_flops_per_item is not None:
+        flops = analytic_flops_per_item * batch_size
+
+    plain_jit = _make_plain_step(loss_fn, tx, mesh)
+    pstate = replicate_state(
+        (_deep_copy(params), tx.init(params), _deep_copy(mstate)), mesh
     )
+    compiled_plain = plain_jit.lower(pstate, batch).compile()
+
+    def plain_compiled_fn(s, b):
+        s, loss = compiled_plain(s, b)
+        return s, {"loss": loss}
+
+    t_fw, t_plain = _time_pair(
+        lambda s, b: compiled_fw(s, b), state,
+        plain_compiled_fn, pstate, batch, ITERS,
+    )
+    del state, pstate, params, mstate, variables, compiled_fw, compiled_plain
+
+    peak = _chip_peak_flops()
+    n_dev = len(jax.devices())
+    rate = batch_size * per_item_scale / t_fw
+    result = {
+        "metric": name,
+        "value": round(rate, 2),
+        "unit": unit,
+        "vs_baseline": round(t_plain / t_fw, 4),
+        "ms_per_step": round(t_fw * 1e3, 3),
+        "ms_per_step_plain": round(t_plain * 1e3, 3),
+    }
+    if flops is not None:
+        result["tflops_per_step"] = round(flops / 1e12, 4)
+        result["model_tflops_per_sec"] = round(flops / t_fw / 1e12, 2)
+        if peak is not None:
+            result["mfu"] = round(flops / t_fw / (peak * n_dev), 4)
+    return result
+
+
+def main():
+    on_tpu = jax.devices()[0].platform == "tpu"
+    n_dev = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    results = []
+
+    # ---- vision configs -------------------------------------------------
+    if on_tpu:
+        vb, hw, classes, filters = 64, 224, 1000, 64
+    else:  # CPU smoke mode so the script stays runnable anywhere
+        vb, hw, classes, filters = 4, 32, 10, 8
+    vbatch_size = vb * n_dev
+    vimages = jax.random.normal(jax.random.PRNGKey(1), (vbatch_size, hw, hw, 3))
+    vlabels = jax.random.randint(jax.random.PRNGKey(2), (vbatch_size,), 0, classes)
+    vbatch = shard_batch({"image": vimages, "label": vlabels}, mesh)
+    x0 = jnp.zeros((vb, hw, hw, 3), jnp.float32)
+    suffix = "" if on_tpu else "_cpusmoke"
+
+    # ResNet50: ~4.1 GFLOP/img fwd @224 => ~12.3 fwd+bwd (analytic fallback)
+    for dtype, tag in ((jnp.float32, "fp32"), (jnp.bfloat16, "bf16")):
+        model = ResNet50(num_classes=classes, num_filters=filters, dtype=dtype)
+        results.append(_run_config(
+            f"resnet50_{tag}_b{vb}_images_per_sec{suffix}", "images/sec", 1,
+            model, classification_loss_fn(model),
+            optax.sgd(0.1, momentum=0.9), mesh, vbatch, vbatch_size,
+            12.3e9 if on_tpu else None, (x0,), {"train": False},
+        ))
+        print(json.dumps(results[-1]), flush=True)
+
+    # VGG16: ~15.5 GFLOP/img fwd @224 => ~46.5 fwd+bwd.  Dropout with a
+    # fixed fold-in key (per-step reseeding would break jit caching).
+    model = VGG16(num_classes=classes, dtype=jnp.float32)
+    results.append(_run_config(
+        f"vgg16_fp32_b{vb}_images_per_sec{suffix}", "images/sec", 1,
+        model,
+        classification_loss_fn(
+            model, rngs_fn=lambda: {"dropout": jax.random.PRNGKey(0)}),
+        optax.sgd(0.1, momentum=0.9), mesh, vbatch, vbatch_size,
+        46.5e9 if on_tpu else None, (x0,), {"train": False},
+    ))
+    print(json.dumps(results[-1]), flush=True)
+    del vbatch, vimages, vlabels
+
+    # ---- BERT-base fine-tune (BASELINE.json configs[3]) -----------------
+    if on_tpu:
+        bb, seq = 32, 128
+        cfg = bert_config(max_seq_len=seq)
+    else:
+        bb, seq = 2, 16
+        cfg = bert_config(vocab_size=128, num_layers=2, num_heads=2,
+                          d_model=32, d_ff=64, max_seq_len=seq)
+    bbatch_size = bb * n_dev
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(3), (bbatch_size, seq), 0, cfg.vocab_size)
+    blabels = jax.random.randint(jax.random.PRNGKey(4), (bbatch_size,), 0, 2)
+    bbatch = shard_batch({"tokens": tokens, "label": blabels}, mesh)
+    bmodel = BertClassifier(cfg, num_classes=2)
+
+    def bert_loss(params, model_state, batch):
+        logits = bmodel.apply({"params": params}, batch["tokens"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"]).mean()
+        return loss, model_state
+
+    # analytic fallback: 6 * params * tokens (BERT-base ~110M params)
+    results.append(_run_config(
+        f"bert_base_ft_bf16_b{bb}_tokens_per_sec{suffix}", "tokens/sec", seq,
+        bmodel, bert_loss, optax.adamw(1e-4), mesh, bbatch, bbatch_size,
+        (6 * 110e6 * seq) if on_tpu else None,
+        (jnp.zeros((bb, seq), jnp.int32),), {},
+    ))
+    print(json.dumps(results[-1]), flush=True)
+
+    # headline line (same metric name as round 1) + the full matrix
+    headline = dict(results[0])
+    headline["configs"] = results
+    print(json.dumps(headline), flush=True)
 
 
 if __name__ == "__main__":

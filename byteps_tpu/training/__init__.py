@@ -3,6 +3,7 @@ callbacks."""
 
 from .optimizer import DistributedOptimizer, push_pull_gradients
 from .overlap import OverlapState, make_delayed_grad_step
+from .trainer import Trainer
 from .step import (
     TrainState,
     classification_loss_fn,
@@ -16,5 +17,5 @@ __all__ = [
     "DistributedOptimizer", "push_pull_gradients",
     "TrainState", "create_train_state", "make_data_parallel_step",
     "shard_batch", "replicate_state", "classification_loss_fn",
-    "OverlapState", "make_delayed_grad_step",
+    "OverlapState", "make_delayed_grad_step", "Trainer",
 ]

@@ -53,16 +53,38 @@ class Trainer:
         async_store=None,
         async_interval: int = 1,
         worker_id: Optional[int] = None,
+        overlap: bool = False,
     ):
         bps.init()
         from ..common.config import get_config
 
         self.mesh = mesh if mesh is not None else bps.mesh()
-        self.step_fn = make_data_parallel_step(
-            loss_fn, optimizer, self.mesh, axes=tuple(axes),
-            compression=compression,
-            backward_passes_per_step=backward_passes_per_step,
-        )
+        # --- ByteScheduler mode (reference bytescheduler/torch/optimizer.py):
+        # cross-iteration comm/compute overlap via the delayed-gradient step
+        # (training/overlap.py).  The reference opts in by wrapping the
+        # optimizer; here it is a Trainer flag.  fit() flushes the final
+        # pending gradients (the analog of ByteScheduler's last-step
+        # synchronize, optimizer.py:75-97).
+        self.overlap = bool(overlap)
+        if self.overlap:
+            if async_mode:
+                raise ValueError("overlap=True is a synchronous schedule; "
+                                 "it cannot combine with async_mode")
+            if backward_passes_per_step != 1:
+                raise ValueError("overlap=True does not compose with "
+                                 "backward_passes_per_step > 1")
+            from .overlap import make_delayed_grad_step
+
+            self.step_fn = make_delayed_grad_step(
+                loss_fn, optimizer, self.mesh, axes=tuple(axes),
+                compression=compression,
+            )
+        else:
+            self.step_fn = make_data_parallel_step(
+                loss_fn, optimizer, self.mesh, axes=tuple(axes),
+                compression=compression,
+                backward_passes_per_step=backward_passes_per_step,
+            )
         self.ckpt = (
             CheckpointManager(checkpoint_dir, checkpoint_every, checkpoint_keep)
             if checkpoint_dir else None
@@ -102,7 +124,9 @@ class Trainer:
             restored, step = self.ckpt.restore_latest(template=tuple(state))
             if restored is not None:
                 bps_log.info("resuming from checkpoint step %d", step)
-                state = TrainState(*restored)
+                # reconstruct whatever state type the step uses (TrainState,
+                # or OverlapState in overlap mode)
+                state = type(state)(*restored)
             else:
                 state = None
         if state is None:
@@ -183,6 +207,9 @@ class Trainer:
                     "step %d %s (%.2f steps/s)", step_no,
                     {k: round(v, 4) for k, v in avg.items()}, rate,
                 )
+        if self.overlap:
+            # apply the final pending (1-step-stale) gradients
+            state = self.step_fn.flush(state)
         self.state = state
         return state
 

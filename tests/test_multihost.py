@@ -69,6 +69,11 @@ def test_two_process_push_pull(tmp_path):
     for wid in range(2):
         env = dict(os.environ)
         env.pop("XLA_FLAGS", None)  # children get 1 real CPU device each
+        # the worker script lives in tmp_path, so its sys.path does not
+        # include the repo; make byteps_tpu importable explicitly
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        prev = env.get("PYTHONPATH")
+        env["PYTHONPATH"] = repo_root + (os.pathsep + prev if prev else "")
         env.update(
             JAX_PLATFORMS="cpu",
             DMLC_ROLE="worker",

@@ -170,3 +170,36 @@ def test_collectives_independent_of_batch(mesh):
     # while the loss psum legitimately touches the batch
     batch_free = [c for c in collectives if not (c[1] & batch_positions)]
     assert batch_free, f"all collectives depend on the batch: {collectives}"
+
+
+def test_trainer_overlap_mode_converges():
+    """Trainer(overlap=True) — the user-facing ByteScheduler opt-in
+    (reference wraps the optimizer; here a Trainer flag) — trains to
+    convergence and flushes the final pending gradients."""
+    from byteps_tpu.training.trainer import Trainer
+
+    def loss_fn(params, mstate, batch):
+        pred = batch["x"] @ params["w"]
+        return jnp.mean((pred - batch["y"]) ** 2), mstate
+
+    w_true = jnp.array([1.0, -2.0, 0.5, 3.0])
+    x = jax.random.normal(jax.random.PRNGKey(0), (64, 4))
+    data = [{"x": x, "y": x @ w_true}] * 200
+
+    trainer = Trainer(loss_fn=loss_fn, optimizer=optax.sgd(0.05),
+                      log_every=0, overlap=True)
+    state = trainer.fit({"w": jnp.zeros((4,))}, {}, iter(data))
+    assert isinstance(state, OverlapState)
+    np.testing.assert_allclose(np.asarray(state.params["w"]),
+                               np.asarray(w_true), atol=0.05)
+    # flush already applied: pending is all zeros
+    for leaf in jax.tree_util.tree_leaves(state.pending):
+        np.testing.assert_allclose(np.asarray(leaf), 0.0)
+
+
+def test_trainer_overlap_rejects_async():
+    from byteps_tpu.training.trainer import Trainer
+
+    with pytest.raises(ValueError):
+        Trainer(loss_fn=lambda p, m, b: (0.0, m), optimizer=optax.sgd(0.1),
+                overlap=True, async_mode=True)
