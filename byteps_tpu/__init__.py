@@ -26,6 +26,7 @@ from .api import (  # noqa: F401
     poll,
     push_pull,
     push_pull_async,
+    push_pull_sparse,
     rank,
     shutdown,
     size,

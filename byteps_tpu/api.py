@@ -285,6 +285,102 @@ def push_pull_async(
     )
 
 
+def push_pull_sparse(
+    indices,
+    values,
+    num_rows: int,
+    average: bool = False,
+    axis_name: Optional[Any] = None,
+):
+    """Row-sparse push_pull (the reference's reserved-but-unimplemented
+    ``kRowSparsePushPull``, common.h:212-216): workers contribute
+    ``(indices [k], values [k, d])`` embedding-row gradients and every
+    worker receives the dense ``[num_rows, d]`` sum (or mean).
+
+    Inside shard_map pass ``axis_name`` — only the nonzero rows cross the
+    wire (parallel/collectives.sparse_push_pull).  Eager mode takes
+    contributions stacked on a leading worker axis (``indices [n, k]``,
+    ``values [n, k, d]``) like eager push_pull, and reduces locally.
+    """
+    _require_init()
+    if axis_name is not None:
+        axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+        return _collectives.sparse_push_pull(
+            indices, values, num_rows, axes=axes, average=average
+        )
+    if jax.process_count() > 1:
+        return _process_push_pull_sparse(indices, values, num_rows, average)
+    n = size()
+    indices = jnp.asarray(indices)
+    values = jnp.asarray(values)
+    if n == 1 and indices.ndim == 1:
+        indices, values = indices[None], values[None]
+    if indices.ndim != 2 or values.ndim != 3 or indices.shape[0] != n:
+        raise ValueError(
+            f"eager push_pull_sparse with size()=={n} expects stacked "
+            f"indices [{n}, k] and values [{n}, k, d]; got "
+            f"{indices.shape} / {values.shape}"
+        )
+    dense = jnp.zeros((num_rows, values.shape[-1]), values.dtype)
+    dense = dense.at[indices.reshape(-1)].add(
+        values.reshape(-1, values.shape[-1]), mode="drop")
+    return dense / n if average else dense
+
+
+def _process_push_pull_sparse(indices, values, num_rows: int, average: bool):
+    """Cross-process eager sparse reduce, worker == process (same slot
+    trick as _multihost_push_pull): the process's contribution rides in
+    its first local device slot; padding slots carry ``num_rows`` indices,
+    which the scatter's drop mode discards — so the mesh-wide gather+add
+    equals the sum over processes."""
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh, axes = _state.mesh, tuple(_state.reduce_axes)
+    idx = np.asarray(indices)
+    val = np.asarray(values)
+    if idx.ndim != 1 or val.ndim != 2:
+        raise ValueError(
+            "multi-process eager push_pull_sparse takes this process's "
+            f"contribution: indices [k], values [k, d]; got {idx.shape} / "
+            f"{val.shape}")
+    slots = jax.local_device_count()
+    pad_idx = np.full((slots - 1,) + idx.shape, num_rows, idx.dtype)
+    pad_val = np.zeros((slots - 1,) + val.shape, val.dtype)
+    idx = np.concatenate([idx[None], pad_idx]) if slots > 1 else idx[None]
+    val = np.concatenate([val[None], pad_val]) if slots > 1 else val[None]
+    sharding = NamedSharding(mesh, P(axes))
+    g_idx = jax.make_array_from_process_local_data(sharding, idx)
+    g_val = jax.make_array_from_process_local_data(sharding, val)
+    fn = jax.jit(_collectives.shard_map(
+        lambda i, v: _collectives.sparse_push_pull(
+            i[0], v[0], num_rows, axes=axes, average=False),
+        mesh, in_specs=(P(axes), P(axes)), out_specs=P(),
+    ))
+    out = fn(g_idx, g_val)
+    return out / jax.process_count() if average else out
+
+
+def push_pull_async_process(
+    tensor,
+    average: bool = True,
+    name: Optional[str] = None,
+    version: int = 0,
+    priority: int = 0,
+    compression: type = Compression.none,
+) -> int:
+    """Eager push_pull with **one worker == one process** semantics in every
+    topology (the reference's Horovod contract: a training process
+    contributes one tensor).  Used by the multihost path and by front-ends
+    whose programs are process-replicated (e.g. ``byteps_tpu.torch``).
+    With one process it is the identity; name/version/priority are accepted
+    for API parity (the reduce runs synchronously as one SPMD program)."""
+    del name, version, priority
+    _require_init()
+    wire = getattr(compression, "wire_dtype", None)
+    return _multihost_push_pull(tensor, average=average, wire=wire)
+
+
 def _multihost_push_pull(tensor, average: bool, wire) -> int:
     """Cross-process eager reduce: every process contributes its local
     slots' tensors, the collective spans the whole mesh (the role of the

@@ -113,6 +113,53 @@ def push_pull_shard(
     return y.astype(orig_dtype)
 
 
+def sparse_push_pull(
+    indices: jax.Array,
+    values: jax.Array,
+    num_rows: int,
+    axes: Sequence[str] = ("dp",),
+    average: bool = False,
+    wire_dtype=None,
+) -> jax.Array:
+    """Row-sparse allreduce — the operation the reference *reserves* as
+    ``kRowSparsePushPull`` (common.h:212-216) and lists as future work
+    (README.md:106-110) but never implements.
+
+    Call inside shard_map.  Each worker contributes gradients for ``k``
+    embedding rows: ``indices [k]`` (int row ids, duplicates allowed) and
+    ``values [k, d]``; every worker receives the dense ``[num_rows, d]``
+    sum (or mean over workers) of all contributions.
+
+    Wire traffic is ``world * k * d`` (all_gather of the nonzero rows)
+    instead of the dense allreduce's ``~2 * num_rows * d / world`` per
+    link — the sparse win whenever ``k << num_rows / world²``-ish, i.e.
+    the classic embedding-gradient regime the PS architecture was built
+    for.  The scatter-add runs on-device per worker; XLA lowers it to an
+    efficient sorted segment-sum.
+    """
+    axes = tuple(axes)
+    if values.ndim != 2:
+        raise ValueError(f"values must be [k, d]; got {values.shape}")
+    if indices.shape[0] != values.shape[0]:
+        raise ValueError("indices and values disagree on k")
+    orig_dtype = values.dtype
+    if wire_dtype is not None and values.dtype != wire_dtype:
+        values = values.astype(wire_dtype)
+
+    # gather every worker's (indices, values) — the only communication
+    all_idx = indices
+    all_val = values
+    for ax in reversed(axes):
+        all_idx = lax.all_gather(all_idx, ax, axis=0, tiled=True)
+        all_val = lax.all_gather(all_val, ax, axis=0, tiled=True)
+
+    dense = jnp.zeros((num_rows, values.shape[1]), all_val.dtype)
+    dense = dense.at[all_idx].add(all_val, mode="drop")
+    if average:
+        dense = dense / _axis_size(axes)
+    return dense.astype(orig_dtype)
+
+
 def broadcast_shard(
     x: jax.Array,
     root_rank: int = 0,

@@ -1,0 +1,265 @@
+"""PyTorch front-end — the byteps_tpu rendering of the reference's
+``byteps.torch`` plugin (torch/__init__.py, torch/ops.py): the same
+Horovod-compatible surface for **torch (CPU) training programs whose
+collectives ride the TPU mesh**.
+
+Mapping: one torch process == one worker (the reference maps one process
+per GPU).  Tensors convert torch↔numpy at the boundary; the reduction
+itself runs as the eager engine's scheduled SPMD program
+(api.push_pull_async), across processes via the multihost path when
+launched through ``bpslaunch``/`jax.distributed`.
+
+Differences from the reference, by design:
+  * no CUDA ready-events — torch CPU tensors are ready when passed;
+  * ``DistributedOptimizer`` communicates at ``step()`` rather than from
+    autograd hooks: on a CPU front-end there is no backward/comm overlap
+    to win, and synchronous-at-step keeps torch's autograd untouched.
+    ``backward_passes_per_step`` accumulates locally exactly like the
+    reference (torch/__init__.py:107-154).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+from .. import api as _api
+from ..ops.compression import Compression
+
+__all__ = [
+    "init", "shutdown", "rank", "size", "local_rank", "local_size",
+    "declare", "push_pull", "push_pull_async", "push_pull_inplace",
+    "push_pull_async_inplace", "poll", "synchronize",
+    "broadcast_parameters", "broadcast_optimizer_state",
+    "DistributedOptimizer", "Compression",
+]
+
+init = _api.init
+shutdown = _api.shutdown
+rank = _api.rank
+local_rank = _api.local_rank
+local_size = _api.local_size
+declare = _api.declare
+
+
+def size() -> int:
+    """One worker == one torch process (reference byteps.torch maps one
+    process per GPU) — NOT the mesh device count ``api.size()`` reports
+    for SPMD programs."""
+    import jax
+
+    return jax.process_count()
+
+
+def _torch():
+    import torch  # local import: the framework must not require torch
+
+    return torch
+
+
+def _to_np(t) -> np.ndarray:
+    torch = _torch()
+    if isinstance(t, torch.Tensor):
+        return t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+# handle -> (template tensor, inplace) for result conversion
+_handles: Dict[int, Tuple[Any, bool]] = {}
+_handles_lock = threading.Lock()
+
+
+def push_pull_async(tensor, average: bool = True, name: Optional[str] = None,
+                    version: int = 0, priority: int = 0,
+                    compression: type = Compression.none) -> int:
+    """Async push_pull of a torch tensor; returns a handle
+    (reference torch/ops.py:144-161)."""
+    handle = _api.push_pull_async_process(
+        _to_np(tensor), average=average, name=name, version=version,
+        priority=priority, compression=compression,
+    )
+    with _handles_lock:
+        _handles[handle] = (tensor, False)
+    return handle
+
+
+def push_pull_async_inplace(tensor, average: bool = True,
+                            name: Optional[str] = None, version: int = 0,
+                            priority: int = 0,
+                            compression: type = Compression.none) -> int:
+    """In-place variant (reference torch/ops.py:163-183): ``synchronize``
+    writes the result back into ``tensor``."""
+    handle = _api.push_pull_async_process(
+        _to_np(tensor), average=average, name=name, version=version,
+        priority=priority, compression=compression,
+    )
+    with _handles_lock:
+        _handles[handle] = (tensor, True)
+    return handle
+
+
+def poll(handle: int) -> bool:
+    return _api.poll(handle)
+
+
+def synchronize(handle: int):
+    """Block until the handle completes; returns a torch tensor
+    (writes in place for the _inplace variants, reference
+    torch/ops.py:204-218)."""
+    torch = _torch()
+    out = np.asarray(_api.synchronize(handle))
+    with _handles_lock:
+        template, inplace = _handles.pop(handle, (None, False))
+    if template is None or not isinstance(template, torch.Tensor):
+        return torch.from_numpy(out.copy())
+    result = torch.from_numpy(out.copy()).to(dtype=template.dtype)
+    if inplace:
+        with torch.no_grad():
+            template.copy_(result.view_as(template))
+        return template
+    return result.view_as(template)
+
+
+def push_pull(tensor, average: bool = True, name: Optional[str] = None,
+              version: int = 0, priority: int = 0,
+              compression: type = Compression.none):
+    return synchronize(push_pull_async(
+        tensor, average=average, name=name, version=version,
+        priority=priority, compression=compression))
+
+
+def push_pull_inplace(tensor, average: bool = True,
+                      name: Optional[str] = None, version: int = 0,
+                      priority: int = 0,
+                      compression: type = Compression.none):
+    return synchronize(push_pull_async_inplace(
+        tensor, average=average, name=name, version=version,
+        priority=priority, compression=compression))
+
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """In-place broadcast of a ``state_dict`` or iterable of
+    ``(name, tensor)`` (reference torch/__init__.py:234-262)."""
+    torch = _torch()
+    if isinstance(params, dict):
+        items = sorted(params.items(), key=lambda nv: nv[0])
+    else:
+        items = sorted(params, key=lambda nv: nv[0])
+    items = [(n, t) for n, t in items if t is not None]
+    # one pytree == ONE process-level collective for the whole state dict
+    # (api.broadcast_parameters takes a dict; per-tensor calls would run
+    # hundreds of sequential collectives at startup)
+    tree = {f"Parameter.{n}": _to_np(t) for n, t in items}
+    out = _api.broadcast_parameters(tree, root_rank=root_rank)
+    with torch.no_grad():
+        for n, t in items:
+            a = np.asarray(out[f"Parameter.{n}"])
+            t.copy_(torch.from_numpy(a.copy()).to(dtype=t.dtype).view_as(t))
+
+
+def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
+    """Broadcast a torch optimizer's state tensors + scalar
+    hyperparameters from root (reference torch/__init__.py:265-381 —
+    scalars tensor-ized exactly like there)."""
+    torch = _torch()
+    state_dict = optimizer.state_dict()
+    # gather everything broadcastable into ONE pytree == one collective
+    # (scalars in param_groups — lr, momentum, ... — ride as 0-d arrays,
+    # tensor-ized exactly like the reference)
+    tree = {}
+    for gi, group in enumerate(state_dict["param_groups"]):
+        for key, value in group.items():
+            if isinstance(value, (int, float)):
+                tree[f"OptGroup.{gi}.{key}"] = np.asarray(value, np.float64)
+    for pid, pstate in state_dict["state"].items():
+        for key, value in pstate.items():
+            if isinstance(value, torch.Tensor):
+                tree[f"OptState.{pid}.{key}"] = _to_np(value)
+            elif isinstance(value, (int, float)):
+                tree[f"OptState.{pid}.{key}"] = np.asarray(value, np.float64)
+    out = _api.broadcast_parameters(tree, root_rank=root_rank)
+    for gi, group in enumerate(state_dict["param_groups"]):
+        for key, value in group.items():
+            if isinstance(value, (int, float)):
+                group[key] = type(value)(
+                    np.asarray(out[f"OptGroup.{gi}.{key}"]))
+    for pid, pstate in state_dict["state"].items():
+        for key, value in pstate.items():
+            k = f"OptState.{pid}.{key}"
+            if isinstance(value, torch.Tensor):
+                pstate[key] = (
+                    torch.from_numpy(np.asarray(out[k]).copy())
+                    .to(dtype=value.dtype).view_as(value))
+            elif isinstance(value, (int, float)):
+                pstate[key] = type(value)(np.asarray(out[k]))
+    optimizer.load_state_dict(state_dict)
+
+
+def DistributedOptimizer(optimizer, named_parameters: Optional[
+        Iterable[Tuple[str, Any]]] = None,
+        compression: type = Compression.none,
+        backward_passes_per_step: int = 1):
+    """Wrap a ``torch.optim.Optimizer`` so ``step()`` push_pulls (averages)
+    every parameter's gradient across workers first — the reference's
+    dynamic-subclassing factory (torch/__init__.py:226-231, 383-402).
+
+    Gradient names follow the reference's ``Gradient.<name>`` convention
+    (sorted for key load-balance, torch/__init__.py:90-95); anonymous
+    parameters get positional names.
+    """
+    torch = _torch()
+
+    if named_parameters is not None:
+        named = list(named_parameters)
+        names = [n for n, _ in named]
+        if len(names) != len(set(names)):
+            dups = sorted({n for n in names if names.count(n) > 1})
+            raise ValueError(
+                f"named_parameters contains duplicate names: {dups} "
+                "(reference byteps.torch rejects these too)")
+        name_of = {id(p): n for n, p in named}
+    else:
+        name_of = {}
+
+    class _DistributedOptimizer(optimizer.__class__):
+        def __init__(self):  # never called; state comes from the instance
+            pass
+
+        def _grad_names(self):
+            idx = 0
+            for group in self.param_groups:
+                for p in group["params"]:
+                    name = name_of.get(id(p), f"param_{idx}")
+                    yield name, p
+                    idx += 1
+
+        def step(self, closure=None):
+            self._bps_accum = getattr(self, "_bps_accum", 0) + 1
+            if self._bps_accum >= backward_passes_per_step:
+                self._bps_accum = 0
+                handles = []
+                for name, p in sorted(self._grad_names(),
+                                      key=lambda nv: nv[0]):
+                    if p.grad is None:
+                        continue
+                    handles.append((p, push_pull_async_inplace(
+                        p.grad, average=True, name=f"Gradient.{name}",
+                        compression=compression)))
+                for _, h in handles:
+                    synchronize(h)
+                if backward_passes_per_step > 1:
+                    for _, p in self._grad_names():
+                        if p.grad is not None:
+                            with torch.no_grad():
+                                p.grad.div_(backward_passes_per_step)
+                # grads persist after step() like the reference/Horovod —
+                # the user zeroes them (zero_grad here would break loops
+                # that inspect post-step gradient norms)
+                return super().step(closure)
+            return None  # accumulate: skip comm + update like the reference
+
+    opt = optimizer
+    opt.__class__ = _DistributedOptimizer
+    return opt

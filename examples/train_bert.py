@@ -1,0 +1,81 @@
+"""BERT-base sequence-classification fine-tune — the byteps_tpu rendering
+of the reference benchmark matrix's "BERT-base fine-tune" config
+(BASELINE.json configs[3]; run through ByteScheduler in the reference).
+
+Synthetic GLUE-shaped data (token ids + binary labels).  Run::
+
+    python examples/train_bert.py --steps 50 --batch-size 32 --seq-len 128
+    python examples/train_bert.py --overlap     # ByteScheduler-style mode
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import byteps_tpu as bps
+from byteps_tpu.models.bert import BertClassifier, bert_config
+from byteps_tpu.training import Trainer
+
+
+def synthetic_text_batches(batch_size, seq_len, vocab, steps):
+    for i in range(steps):
+        k = jax.random.PRNGKey(i)
+        yield {
+            "tokens": jax.random.randint(k, (batch_size, seq_len), 0, vocab),
+            "label": jax.random.randint(k, (batch_size,), 0, 2),
+        }
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--lr", type=float, default=2e-5)
+    p.add_argument("--fp32", action="store_true",
+                   help="compute in fp32 (default bf16, the TPU-native dtype)")
+    p.add_argument("--overlap", action="store_true")
+    p.add_argument("--tiny", action="store_true",
+                   help="2-layer toy config for CPU smoke runs")
+    args = p.parse_args()
+
+    bps.init()
+    dtype = jnp.float32 if args.fp32 else jnp.bfloat16
+    if args.tiny:
+        cfg = bert_config(vocab_size=512, num_layers=2, num_heads=2,
+                          d_model=64, d_ff=128, max_seq_len=args.seq_len,
+                          dtype=dtype)
+    else:
+        cfg = bert_config(max_seq_len=args.seq_len, dtype=dtype)
+    model = BertClassifier(cfg, num_classes=2)
+
+    def loss_fn(params, model_state, batch):
+        logits = model.apply({"params": params}, batch["tokens"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"]).mean()
+        return loss, model_state
+
+    trainer = Trainer(
+        loss_fn=loss_fn,
+        optimizer=optax.adamw(args.lr),
+        log_every=10,
+        overlap=args.overlap,
+    )
+
+    tokens0 = jnp.zeros((args.batch_size, args.seq_len), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens0)["params"]
+
+    global_batch = args.batch_size * bps.size()
+    batches = synthetic_text_batches(
+        global_batch, args.seq_len, cfg.vocab_size, args.steps)
+    state = trainer.fit(params, {}, batches, steps=args.steps)
+    print(f"done: step {int(state.step)}")
+    bps.shutdown()
+
+
+if __name__ == "__main__":
+    main()

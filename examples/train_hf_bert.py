@@ -1,0 +1,81 @@
+"""Fine-tune a HuggingFace Flax model through byteps_tpu — the drop-in
+story: any flax param pytree + apply function works with the scheduled
+data-parallel step, exactly how the reference's DistributedOptimizer
+wraps stock torchvision/HF models (example/pytorch/benchmark_byteps.py
+pulls models from torchvision; this pulls from transformers).
+
+Random-initialized (this image has no weight egress); point
+``--from-pretrained`` at a local checkpoint directory to start from real
+weights.  Run::
+
+    python examples/train_hf_bert.py --steps 30 --batch-size 16
+    python examples/train_hf_bert.py --tiny          # CPU smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import byteps_tpu as bps
+from byteps_tpu.training import Trainer
+
+
+def build_model(args):
+    from transformers import BertConfig, FlaxBertForSequenceClassification
+
+    if args.tiny:
+        cfg = BertConfig(vocab_size=512, hidden_size=64, num_hidden_layers=2,
+                         num_attention_heads=2, intermediate_size=128,
+                         max_position_embeddings=args.seq_len, num_labels=2)
+    else:
+        cfg = BertConfig(num_labels=2)  # bert-base shape
+    if args.from_pretrained:
+        return FlaxBertForSequenceClassification.from_pretrained(
+            args.from_pretrained, config=cfg)
+    return FlaxBertForSequenceClassification(cfg, seed=0)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--lr", type=float, default=2e-5)
+    p.add_argument("--tiny", action="store_true")
+    p.add_argument("--from-pretrained", default=None,
+                   help="local checkpoint dir (no hub egress in this image)")
+    args = p.parse_args()
+
+    bps.init()
+    model = build_model(args)
+    vocab = model.config.vocab_size
+
+    def loss_fn(params, model_state, batch):
+        logits = model(batch["tokens"], params=params, train=False).logits
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"]).mean()
+        return loss, model_state
+
+    trainer = Trainer(loss_fn=loss_fn, optimizer=optax.adamw(args.lr),
+                      log_every=10)
+
+    def batches():
+        n = args.batch_size * bps.size()
+        for i in range(args.steps):
+            k = jax.random.PRNGKey(i)
+            yield {
+                "tokens": jax.random.randint(k, (n, args.seq_len), 0, vocab),
+                "label": jax.random.randint(k, (n,), 0, 2),
+            }
+
+    state = trainer.fit(dict(model.params), {}, batches(), steps=args.steps)
+    print(f"done: step {int(state.step)}")
+    bps.shutdown()
+
+
+if __name__ == "__main__":
+    main()

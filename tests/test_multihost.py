@@ -49,7 +49,55 @@ _WORKER = textwrap.dedent(
     params = bps.broadcast_parameters(params, root_rank=0)
     np.testing.assert_allclose(np.asarray(params["w"]), 0.0)
 
+    # row-sparse push_pull across processes: worker r contributes rows
+    # [r, 2] with value r+1 => row0=1, row1=2, row2=3 (both touch row 2)
+    idx = np.array([r, 2], np.int32)
+    val = np.full((2, 4), float(r + 1), np.float32)
+    dense = np.asarray(bps.push_pull_sparse(idx, val, num_rows=6))
+    np.testing.assert_allclose(dense[0], 1.0)
+    np.testing.assert_allclose(dense[1], 2.0)
+    np.testing.assert_allclose(dense[2], 3.0)
+    np.testing.assert_allclose(dense[3:], 0.0)
+
     print(f"WORKER_{r}_OK")
+    bps.shutdown()
+    """
+)
+
+
+_TORCH_WORKER = textwrap.dedent(
+    """
+    import numpy as np
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import torch
+    import byteps_tpu.torch as bps
+
+    bps.init()
+    r = bps.rank()
+    assert bps.size() == 2, bps.size()
+
+    # cross-process sum of torch tensors: r+1 each => 3
+    out = bps.push_pull(torch.full((4,), float(r + 1)), average=False,
+                        name="tsum")
+    assert isinstance(out, torch.Tensor), type(out)
+    np.testing.assert_allclose(out.numpy(), 3.0)
+
+    # averaged, in place
+    t = torch.full((4,), float(r + 1))
+    bps.push_pull_inplace(t, average=True, name="tavg")
+    np.testing.assert_allclose(t.numpy(), 1.5)
+
+    # broadcast_parameters: non-root model adopts root's weights
+    m = torch.nn.Linear(2, 2, bias=False)
+    with torch.no_grad():
+        m.weight.fill_(float(r))
+    bps.broadcast_parameters(m.state_dict(), root_rank=0)
+    np.testing.assert_allclose(m.weight.detach().numpy(), 0.0)
+
+    print(f"TORCH_WORKER_{r}_OK")
     bps.shutdown()
     """
 )
@@ -61,9 +109,9 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_push_pull(tmp_path):
+def _run_two_workers(tmp_path, source, ok_marker):
     script = tmp_path / "worker.py"
-    script.write_text(_WORKER)
+    script.write_text(source)
     port = _free_port()
     procs = []
     for wid in range(2):
@@ -101,4 +149,15 @@ def test_two_process_push_pull(tmp_path):
         outs.append(out)
     for wid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {wid} failed:\n{out}"
-        assert f"WORKER_{wid}_OK" in out, out
+        assert ok_marker.format(wid=wid) in out, out
+
+
+def test_two_process_push_pull(tmp_path):
+    _run_two_workers(tmp_path, _WORKER, "WORKER_{wid}_OK")
+
+
+def test_two_process_torch_frontend(tmp_path):
+    """byteps_tpu.torch across 2 real processes: worker==process semantics
+    for push_pull (sum/avg/in-place) and broadcast_parameters."""
+    pytest.importorskip("torch")
+    _run_two_workers(tmp_path, _TORCH_WORKER, "TORCH_WORKER_{wid}_OK")

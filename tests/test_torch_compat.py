@@ -1,0 +1,127 @@
+"""PyTorch front-end tests (byteps_tpu.torch) — the reference's
+``byteps.torch`` surface: push_pull(_async)(_inplace) on torch tensors,
+broadcast_parameters/broadcast_optimizer_state on torch modules/optims,
+and DistributedOptimizer wrapping torch.optim.
+
+Single-process here (the process==worker mapping means size()==1, where
+push_pull is the identity-average — the reference behaves the same); the
+cross-process reduce path is covered by tests/test_multihost.py.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import byteps_tpu.torch as bps_t
+
+
+@pytest.fixture(autouse=True)
+def _init():
+    bps_t.init()
+    yield
+
+
+def test_push_pull_identity_single_worker():
+    x = torch.arange(12, dtype=torch.float32).reshape(3, 4)
+    out = bps_t.push_pull(x.clone(), average=True, name="t0")
+    assert isinstance(out, torch.Tensor)
+    assert out.dtype == torch.float32
+    torch.testing.assert_close(out, x)
+    # sum mode with one worker is also identity
+    out = bps_t.push_pull(x.clone(), average=False, name="t0_sum")
+    torch.testing.assert_close(out, x)
+
+
+def test_push_pull_async_poll_synchronize():
+    x = torch.ones(8)
+    h = bps_t.push_pull_async(x, name="t1")
+    bps_t.synchronize(h)  # completes regardless of poll state
+    h2 = bps_t.push_pull_async(x, name="t1")
+    out = bps_t.synchronize(h2)
+    torch.testing.assert_close(out, x)
+
+
+def test_push_pull_inplace_writes_back():
+    x = torch.full((4,), 3.0)
+    out = bps_t.push_pull_inplace(x, average=True, name="t2")
+    assert out is x
+    torch.testing.assert_close(x, torch.full((4,), 3.0))
+
+
+def test_fp16_compression_roundtrip():
+    x = torch.randn(16)
+    out = bps_t.push_pull(x.clone(), name="t3",
+                          compression=bps_t.Compression.fp16)
+    assert out.dtype == torch.float32
+    torch.testing.assert_close(out, x, rtol=1e-3, atol=1e-3)
+
+
+def test_broadcast_parameters_state_dict():
+    model = torch.nn.Linear(4, 2)
+    before = {k: v.clone() for k, v in model.state_dict().items()}
+    bps_t.broadcast_parameters(model.state_dict(), root_rank=0)
+    # single worker: broadcast is identity, tensors unchanged in place
+    for k, v in model.state_dict().items():
+        torch.testing.assert_close(v, before[k])
+
+
+def test_broadcast_optimizer_state():
+    model = torch.nn.Linear(4, 2)
+    opt = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9)
+    # materialize momentum state
+    model(torch.randn(8, 4)).sum().backward()
+    opt.step()
+    lr_before = opt.param_groups[0]["lr"]
+    bps_t.broadcast_optimizer_state(opt, root_rank=0)
+    assert opt.param_groups[0]["lr"] == pytest.approx(lr_before)
+    for pstate in opt.state_dict()["state"].values():
+        for v in pstate.values():
+            if isinstance(v, torch.Tensor):
+                assert v.dtype in (torch.float32, torch.float64)
+
+
+def test_distributed_optimizer_trains():
+    """The wrapped torch optimizer drives a model to fit a linear target
+    (glue test: grads flow through push_pull, update applies)."""
+    torch.manual_seed(0)
+    model = torch.nn.Linear(4, 1, bias=False)
+    w_true = torch.tensor([[1.0, -2.0, 0.5, 3.0]])
+    x = torch.randn(64, 4)
+    y = x @ w_true.t()
+
+    opt = bps_t.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters(),
+    )
+    for _ in range(200):
+        opt.zero_grad()  # grads persist after step() like the reference
+        loss = torch.nn.functional.mse_loss(model(x), y)
+        loss.backward()
+        opt.step()
+    assert float(loss) < 1e-3
+    torch.testing.assert_close(model.weight.detach(), w_true,
+                               rtol=0.05, atol=0.05)
+
+
+def test_distributed_optimizer_backward_passes_per_step():
+    """k-1 accumulation steps perform no update; the k-th applies the
+    k-averaged gradient (reference backward_passes_per_step semantics)."""
+    model = torch.nn.Linear(2, 1, bias=False)
+    with torch.no_grad():
+        model.weight.fill_(1.0)
+    opt = bps_t.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=1.0),
+        named_parameters=model.named_parameters(),
+        backward_passes_per_step=2,
+    )
+    x = torch.ones(1, 2)
+
+    model(x).sum().backward()      # grad = [1, 1]
+    opt.step()                     # accumulate only
+    torch.testing.assert_close(model.weight,
+                               torch.ones_like(model.weight))
+    model(x).sum().backward()      # grad accumulates to [2, 2]
+    opt.step()                     # update with [2,2]/2 = [1,1]
+    torch.testing.assert_close(model.weight,
+                               torch.zeros_like(model.weight))
