@@ -1,0 +1,213 @@
+"""Native-backed input pipeline — the framework's data loader.
+
+The batch assembly hot loop (shuffled row gather + uint8→float32
+normalize) runs in C++ worker threads (csrc/data_loader.cc) into a ring
+of staging buffers; ``NativeLoader`` yields numpy views that go straight
+to ``jax.device_put`` while the next batches are assembled concurrently.
+The reference delegates this to torchvision's DataLoader in its examples
+(example/pytorch/train_imagenet_resnet50_byteps.py); here it is part of
+the framework's native runtime, next to the OpenMP reducer.
+
+A pure-numpy fallback keeps the API available when the native toolchain
+is absent (same contract, no prefetch thread).
+
+Example::
+
+    loader = NativeLoader(images_u8, labels, batch_size=256,
+                          normalize=(1/255., 0.0), num_threads=4)
+    for batch in loader:                # {"image": f32 [B, ...], "label": i32 [B]}
+        state, metrics = step(state, shard_batch(batch, mesh))
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from .native import reducer as _native
+
+
+def _lib():
+    lib = _native._load()
+    if lib is None:
+        return None
+    try:
+        lib.bps_loader_create
+    except AttributeError:
+        # stale .so built before csrc/data_loader.cc existed (old checkout,
+        # baked image, source-less install): reducer symbols only — use the
+        # numpy fallback rather than crashing
+        return None
+    if not hasattr(lib.bps_loader_create, "_bps_typed"):
+        lib.bps_loader_create.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_float, ctypes.c_float, ctypes.c_uint64, ctypes.c_int,
+        ]
+        lib.bps_loader_create.restype = ctypes.c_void_p
+        lib.bps_loader_acquire.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_void_p),
+        ]
+        lib.bps_loader_acquire.restype = ctypes.c_int
+        lib.bps_loader_release.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.bps_loader_release.restype = None
+        lib.bps_loader_epoch.argtypes = [ctypes.c_void_p]
+        lib.bps_loader_epoch.restype = ctypes.c_int64
+        lib.bps_loader_destroy.argtypes = [ctypes.c_void_p]
+        lib.bps_loader_destroy.restype = None
+        lib.bps_loader_create._bps_typed = True
+    return lib
+
+
+class NativeLoader:
+    """Iterable over ``{"image": ..., "label": ...}`` batches assembled by
+    C++ prefetch threads (numpy fallback when the native lib is missing).
+
+    Args:
+      data: ``uint8 [N, ...]`` samples (any trailing shape).
+      labels: ``int32 [N]`` or None.
+      batch_size: samples per emitted batch (only full batches emit).
+      normalize: optional ``(scale, bias)`` — emits
+        ``float32 x*scale + bias``; None emits raw uint8.
+      shuffle: per-epoch reshuffle (seeded).
+      num_threads / depth: prefetch workers / ring slots.  With
+        ``num_threads=1`` batch order is exactly the seeded permutation.
+      copy: yield copies (safe to hold across iterations).  ``False``
+        yields zero-copy ring views valid only until the next ``next()``
+        — the fast path for immediate ``jax.device_put``.
+    """
+
+    def __init__(self, data: np.ndarray, labels: Optional[np.ndarray],
+                 batch_size: int, normalize: Optional[Tuple[float, float]] = None,
+                 shuffle: bool = True, num_threads: int = 4, depth: int = 4,
+                 seed: int = 0, copy: bool = True):
+        self._data = np.ascontiguousarray(data, dtype=np.uint8)
+        n = self._data.shape[0]
+        if not 0 < batch_size <= n:
+            raise ValueError(f"batch_size {batch_size} vs {n} samples")
+        self._labels = (None if labels is None else
+                        np.ascontiguousarray(labels, dtype=np.int32))
+        if self._labels is not None and self._labels.shape[0] != n:
+            raise ValueError("labels length mismatch")
+        self.batch_size = int(batch_size)
+        self.sample_shape = self._data.shape[1:]
+        self._sample_bytes = int(np.prod(self.sample_shape, dtype=np.int64))
+        self._mode = 0 if normalize is None else 1
+        self._scale, self._bias = (normalize or (1.0, 0.0))
+        self._shuffle = bool(shuffle)
+        self._seed = int(seed)
+        self._copy = bool(copy)
+        self._lock = threading.Lock()
+        self._pending_slot: Optional[int] = None
+
+        lib = _lib()
+        self._handle = None
+        self._closed = False
+        self._rng_epoch = 0  # also the post-close epoch report in native mode
+        if lib is not None:
+            self._handle = lib.bps_loader_create(
+                self._data.ctypes.data_as(ctypes.c_void_p), n,
+                self._sample_bytes,
+                (self._labels.ctypes.data_as(ctypes.c_void_p)
+                 if self._labels is not None else None),
+                self.batch_size, int(depth), int(num_threads), self._mode,
+                float(self._scale), float(self._bias),
+                self._seed & 0xFFFFFFFFFFFFFFFF, int(self._shuffle),
+            )
+        if self._handle is None:
+            # numpy fallback state (same permutation contract)
+            self._perm = np.arange(n)
+            self._fallback_reshuffle()
+            self._cursor = 0
+
+    # ------------------------------------------------------------ fallback
+    def _fallback_reshuffle(self):
+        if self._shuffle:
+            rng = np.random.RandomState(
+                (self._seed + 0x9E3779B9 * self._rng_epoch) & 0x7FFFFFFF)
+            rng.shuffle(self._perm)
+
+    def _fallback_next(self):
+        idx = np.empty(self.batch_size, np.int64)
+        for b in range(self.batch_size):
+            if self._cursor >= self._data.shape[0]:
+                self._cursor = 0
+                self._rng_epoch += 1
+                self._fallback_reshuffle()
+            idx[b] = self._perm[self._cursor]
+            self._cursor += 1
+        x = self._data[idx]
+        if self._mode == 1:
+            x = x.astype(np.float32) * self._scale + self._bias
+        y = (self._labels[idx] if self._labels is not None
+             else np.zeros(self.batch_size, np.int32))
+        return x, y
+
+    # ------------------------------------------------------------ iterator
+    @property
+    def native(self) -> bool:
+        return self._handle is not None
+
+    @property
+    def epoch(self) -> int:
+        if self._handle is not None:
+            return int(_lib().bps_loader_epoch(self._handle))
+        return self._rng_epoch
+
+    def __iter__(self) -> Iterator[dict]:
+        while True:
+            yield self.next()
+
+    def next(self) -> dict:
+        if self._closed:
+            raise RuntimeError("NativeLoader is closed")
+        if self._handle is None:
+            x, y = self._fallback_next()
+        else:
+            lib = _lib()
+            with self._lock:
+                if self._pending_slot is not None:
+                    lib.bps_loader_release(self._handle, self._pending_slot)
+                    self._pending_slot = None
+                dptr = ctypes.c_void_p()
+                lptr = ctypes.c_void_p()
+                slot = lib.bps_loader_acquire(
+                    self._handle, ctypes.byref(dptr), ctypes.byref(lptr))
+                out_dtype = np.float32 if self._mode == 1 else np.uint8
+                nbytes = (self.batch_size * self._sample_bytes *
+                          np.dtype(out_dtype).itemsize)
+                x = np.frombuffer(
+                    (ctypes.c_char * nbytes).from_address(dptr.value),
+                    dtype=out_dtype,
+                ).reshape((self.batch_size,) + self.sample_shape)
+                y = np.frombuffer(
+                    (ctypes.c_char * (self.batch_size * 4)).from_address(
+                        lptr.value), dtype=np.int32)
+                if self._copy:
+                    x, y = x.copy(), y.copy()
+                    lib.bps_loader_release(self._handle, slot)
+                else:
+                    self._pending_slot = slot
+        return {"image": x, "label": y}
+
+    def close(self) -> None:
+        self._closed = True
+        if self._handle is not None:
+            lib = _lib()
+            self._rng_epoch = int(lib.bps_loader_epoch(self._handle))
+            with self._lock:
+                if self._pending_slot is not None:
+                    lib.bps_loader_release(self._handle, self._pending_slot)
+                    self._pending_slot = None
+            lib.bps_loader_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):  # best-effort
+        try:
+            self.close()
+        except Exception:
+            pass

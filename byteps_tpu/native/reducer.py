@@ -21,7 +21,12 @@ from ..common import logging as bps_log
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_HERE, "libbyteps_native.so")
-_SRC = os.path.normpath(os.path.join(_HERE, "..", "..", "csrc", "byteps_native.cc"))
+_CSRC = os.path.normpath(os.path.join(_HERE, "..", "..", "csrc"))
+_SRCS = [
+    os.path.join(_CSRC, "byteps_native.cc"),
+    os.path.join(_CSRC, "data_loader.cc"),
+]
+_SRC = _SRCS[0]  # existence probe
 
 _lib: Optional[ctypes.CDLL] = None
 _lock = threading.Lock()
@@ -30,10 +35,11 @@ _build_failed = False
 
 def _build() -> bool:
     """Compile the native lib in place (g++ is in the baked image)."""
+    srcs = [s for s in _SRCS if os.path.exists(s)]
     cmd = [
         os.environ.get("CXX", "g++"),
-        "-O3", "-march=native", "-fopenmp", "-fPIC", "-std=c++17",
-        "-shared", "-o", _SO, _SRC,
+        "-O3", "-march=native", "-fopenmp", "-pthread", "-fPIC",
+        "-std=c++17", "-shared", "-o", _SO, *srcs,
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
@@ -50,10 +56,11 @@ def _load() -> Optional[ctypes.CDLL]:
             return _lib
         if _build_failed:
             return None
-        if not os.path.exists(_SO) or (
-            os.path.exists(_SRC)
-            and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
-        ):
+        stale = os.path.exists(_SO) and any(
+            os.path.exists(s) and os.path.getmtime(s) > os.path.getmtime(_SO)
+            for s in _SRCS
+        )
+        if not os.path.exists(_SO) or stale:
             if not os.path.exists(_SRC) or not _build():
                 _build_failed = True
                 return None

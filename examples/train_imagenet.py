@@ -15,6 +15,7 @@ import argparse
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 import byteps_tpu as bps
@@ -23,15 +24,22 @@ from byteps_tpu.training import Trainer, classification_loss_fn
 from byteps_tpu.training.callbacks import warmup_schedule
 
 
-def synthetic_imagenet_batches(batch_size, image_size, steps, classes=1000):
-    """Deterministic synthetic batches (no dataset egress in this image)."""
-    for i in range(steps):
-        k = jax.random.PRNGKey(i)
-        yield {
-            "image": jax.random.normal(
-                k, (batch_size, image_size, image_size, 3)),
-            "label": jax.random.randint(k, (batch_size,), 0, classes),
-        }
+def synthetic_imagenet_loader(batch_size, image_size, classes=1000,
+                              n_samples=None):
+    """uint8 synthetic dataset through the native C++ prefetch loader
+    (byteps_tpu/data.py) — the full input pipeline: shuffled gather +
+    u8→f32 normalize in worker threads, overlapped with the TPU step.
+    Swap the arrays for a real memory-mapped dataset."""
+    from byteps_tpu.data import NativeLoader
+
+    if n_samples is None:
+        n_samples = max(512, 2 * batch_size)  # dataset must cover a batch
+    rng = np.random.RandomState(0)
+    images = rng.randint(0, 256, (n_samples, image_size, image_size, 3),
+                         dtype=np.uint8)
+    labels = rng.randint(0, classes, n_samples).astype(np.int32)
+    return NativeLoader(images, labels, batch_size=batch_size,
+                        normalize=(1 / 255.0, -0.5), num_threads=4)
 
 
 def main():
@@ -70,10 +78,11 @@ def main():
     model_state = {k: v for k, v in variables.items() if k != "params"}
 
     global_batch = args.batch_size * bps.size()
-    batches = synthetic_imagenet_batches(
-        global_batch, args.image_size, args.steps)
-    state = trainer.fit(params, model_state, batches, steps=args.steps)
-    print(f"done: step {int(state.step)}")
+    loader = synthetic_imagenet_loader(global_batch, args.image_size)
+    print(f"loader: native={loader.native}")
+    state = trainer.fit(params, model_state, iter(loader), steps=args.steps)
+    loader.close()
+    print(f"done: step {int(state.step)} (epoch {loader.epoch})")
     bps.shutdown()
 
 

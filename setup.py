@@ -19,13 +19,17 @@ from setuptools.command.build_py import build_py
 class BuildWithNative(build_py):
     def run(self):
         here = os.path.dirname(os.path.abspath(__file__))
-        src = os.path.join(here, "csrc", "byteps_native.cc")
+        srcs = [
+            os.path.join(here, "csrc", "byteps_native.cc"),
+            os.path.join(here, "csrc", "data_loader.cc"),
+        ]
+        srcs = [s for s in srcs if os.path.exists(s)]
         out = os.path.join(here, "byteps_tpu", "native", "libbyteps_native.so")
-        if os.path.exists(src):
+        if srcs:
             cmd = [
                 os.environ.get("CXX", "g++"),
-                "-O3", "-march=native", "-fopenmp", "-fPIC", "-std=c++17",
-                "-shared", "-o", out, src,
+                "-O3", "-march=native", "-fopenmp", "-pthread", "-fPIC",
+                "-std=c++17", "-shared", "-o", out, *srcs,
             ]
             try:
                 subprocess.run(cmd, check=True, capture_output=True,

@@ -1,0 +1,47 @@
+"""dm-haiku drop-in test: like the HF test, any functional param pytree
+trains through the scheduled data-parallel step — byteps_tpu is adapter-
+free for JAX-family libraries (the reference needs a compiled plugin per
+framework, SURVEY.md §2.2)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh
+
+hk = pytest.importorskip("haiku")
+
+from byteps_tpu.training import make_data_parallel_step, shard_batch
+
+
+def test_haiku_mlp_trains_through_push_pull_step():
+    def net(x):
+        return hk.Sequential([
+            hk.Linear(32), jax.nn.relu, hk.Linear(1),
+        ])(x)
+
+    model = hk.without_apply_rng(hk.transform(net))
+    x0 = jnp.zeros((4, 8))
+    params = model.init(jax.random.PRNGKey(0), x0)
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+
+    w_true = jnp.asarray(np.random.RandomState(0).randn(8, 1), jnp.float32)
+
+    def loss_fn(params, model_state, batch):
+        pred = model.apply(params, batch["x"])
+        return jnp.mean((pred - batch["y"]) ** 2), model_state
+
+    step = make_data_parallel_step(loss_fn, optax.adam(1e-2), mesh)
+    state = step.init_state(params)
+
+    n = 8 * len(jax.devices())
+    x = jnp.asarray(np.random.RandomState(1).randn(n, 8), jnp.float32)
+    batch = shard_batch({"x": x, "y": x @ w_true}, mesh)
+
+    losses = []
+    for _ in range(150):
+        state, metrics = step(state, batch)
+        jax.block_until_ready(state)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < 0.05 * losses[0], (losses[0], losses[-1])
