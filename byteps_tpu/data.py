@@ -105,9 +105,14 @@ class NativeLoader:
         self._pending_slot: Optional[int] = None
 
         lib = _lib()
+        self._libref = lib  # cached; resolved once (hot path uses this)
         self._handle = None
         self._closed = False
-        self._rng_epoch = 0  # also the post-close epoch report in native mode
+        self._consumed = 0  # batches handed to the caller
+        self._rng_epoch = 0  # fallback reshuffle seed counter
+        # samples per epoch after dropping the remainder (no batch ever
+        # mixes two epochs' permutations)
+        self._usable = (n // self.batch_size) * self.batch_size
         if lib is not None:
             self._handle = lib.bps_loader_create(
                 self._data.ctypes.data_as(ctypes.c_void_p), n,
@@ -132,14 +137,12 @@ class NativeLoader:
             rng.shuffle(self._perm)
 
     def _fallback_next(self):
-        idx = np.empty(self.batch_size, np.int64)
-        for b in range(self.batch_size):
-            if self._cursor >= self._data.shape[0]:
-                self._cursor = 0
-                self._rng_epoch += 1
-                self._fallback_reshuffle()
-            idx[b] = self._perm[self._cursor]
-            self._cursor += 1
+        if self._cursor + self.batch_size > self._usable:
+            self._cursor = 0
+            self._rng_epoch += 1
+            self._fallback_reshuffle()
+        idx = self._perm[self._cursor:self._cursor + self.batch_size]
+        self._cursor += self.batch_size
         x = self._data[idx]
         if self._mode == 1:
             x = x.astype(np.float32) * self._scale + self._bias
@@ -154,9 +157,9 @@ class NativeLoader:
 
     @property
     def epoch(self) -> int:
-        if self._handle is not None:
-            return int(_lib().bps_loader_epoch(self._handle))
-        return self._rng_epoch
+        """Epochs fully *consumed* by the caller (prefetch threads may be
+        up to ``depth`` batches ahead; their progress is not reported)."""
+        return self._consumed * self.batch_size // self._usable
 
     def __iter__(self) -> Iterator[dict]:
         while True:
@@ -168,7 +171,7 @@ class NativeLoader:
         if self._handle is None:
             x, y = self._fallback_next()
         else:
-            lib = _lib()
+            lib = self._libref
             with self._lock:
                 if self._pending_slot is not None:
                     lib.bps_loader_release(self._handle, self._pending_slot)
@@ -192,13 +195,13 @@ class NativeLoader:
                     lib.bps_loader_release(self._handle, slot)
                 else:
                     self._pending_slot = slot
+        self._consumed += 1
         return {"image": x, "label": y}
 
     def close(self) -> None:
         self._closed = True
         if self._handle is not None:
-            lib = _lib()
-            self._rng_epoch = int(lib.bps_loader_epoch(self._handle))
+            lib = self._libref
             with self._lock:
                 if self._pending_slot is not None:
                     lib.bps_loader_release(self._handle, self._pending_slot)

@@ -41,11 +41,13 @@ struct Loader {
 
   // batch geometry
   int64_t batch_size = 0;
+  int64_t usable = 0;  // n_samples rounded down to a batch multiple:
+                       // the remainder is dropped so no batch ever mixes
+                       // two epochs' permutations
   int mode = 0;        // 0: raw u8 copy; 1: u8 -> f32 * scale + bias
   float scale = 1.0f;
   float bias = 0.0f;
   bool shuffle = true;
-  bool drop_remainder = true;  // only full batches are emitted
 
   // ring
   int depth = 0;
@@ -98,15 +100,15 @@ struct Loader {
         if (stopping) return;
         slot = free_q.front();
         free_q.pop();
-        // claim the next batch_size positions (wrapping = epoch boundary)
-        for (int64_t b = 0; b < batch_size; ++b) {
-          if (cursor >= n_samples) {
-            cursor = 0;
-            ++epoch;
-            reshuffle_locked();
-          }
-          idx[(size_t)b] = perm[(size_t)cursor++];
+        // claim the next batch_size positions; the epoch's remainder
+        // (< batch_size samples) is dropped at the boundary
+        if (cursor + batch_size > usable) {
+          cursor = 0;
+          ++epoch;
+          reshuffle_locked();
         }
+        for (int64_t b = 0; b < batch_size; ++b)
+          idx[(size_t)b] = perm[(size_t)cursor++];
       }
       fill(slot, idx.data());
       {
@@ -136,6 +138,7 @@ void* bps_loader_create(const uint8_t* data, int64_t n_samples,
   L->sample_bytes = sample_bytes;
   L->labels = labels;
   L->batch_size = batch_size;
+  L->usable = (n_samples / batch_size) * batch_size;
   L->mode = mode;
   L->scale = scale;
   L->bias = bias;

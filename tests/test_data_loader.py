@@ -113,6 +113,45 @@ def test_validation_errors():
         NativeLoader(data, labels[:10], batch_size=4)
 
 
+def test_drop_remainder_no_epoch_mixing():
+    """N not divisible by batch_size: the remainder is dropped — every
+    batch comes from a single epoch's permutation, and with shuffle=False
+    each epoch restarts at sample 0."""
+    data = np.arange(100 * H, dtype=np.uint8).reshape(100, H)[:100]
+    labels = np.arange(100, dtype=np.int32)
+    loader = NativeLoader(data, labels, batch_size=64, shuffle=False,
+                          num_threads=1, depth=2)
+    b0 = loader.next()["label"]
+    b1 = loader.next()["label"]
+    loader.close()
+    np.testing.assert_array_equal(b0, np.arange(64))
+    np.testing.assert_array_equal(b1, np.arange(64))  # epoch 1, not 64..99+wrap
+
+    # shuffled: no duplicate sample within any batch (single-epoch batches)
+    loader = NativeLoader(data, labels, batch_size=64, shuffle=True,
+                          num_threads=4, depth=4, seed=9)
+    for _ in range(8):
+        lab = loader.next()["label"]
+        assert len(set(lab.tolist())) == 64
+    loader.close()
+
+
+def test_epoch_counts_consumed_batches():
+    data, labels = _dataset()  # 64 samples
+    loader = NativeLoader(data, labels, batch_size=16, shuffle=False,
+                          num_threads=2, depth=4)
+    assert loader.epoch == 0
+    for _ in range(4):  # one full epoch consumed
+        loader.next()
+    assert loader.epoch == 1  # prefetch-ahead must not inflate this
+    for _ in range(3):
+        loader.next()
+    assert loader.epoch == 1
+    loader.next()
+    assert loader.epoch == 2
+    loader.close()
+
+
 def test_next_after_close_raises():
     data, labels = _dataset()
     loader = NativeLoader(data, labels, batch_size=4, num_threads=1)
