@@ -1,9 +1,11 @@
 """byteps_tpu.ops — compression and Pallas kernels for the hot paths."""
 
 from .compression import BF16Compressor, Compression, Compressor, FP16Compressor, NoneCompressor
-from .flash_attention import flash_attention
+from .flash_attention import flash_attention, flash_attention_with_lse
+from .fused_cross_entropy import fused_linear_cross_entropy
 
 __all__ = [
     "Compression", "Compressor", "NoneCompressor", "FP16Compressor", "BF16Compressor",
-    "flash_attention",
+    "flash_attention", "flash_attention_with_lse",
+    "fused_linear_cross_entropy",
 ]

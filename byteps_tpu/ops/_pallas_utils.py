@@ -1,0 +1,31 @@
+"""Shared helpers for the Pallas TPU kernels (flash_attention,
+fused_cross_entropy): backend auto-detection and block-size fitting."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+
+
+def resolve_interpret(interpret) -> bool:
+    """None = auto: interpret mode off TPU (CPU tests / virtual meshes),
+    compiled Mosaic kernels on TPU."""
+    if interpret is None:
+        return jax.default_backend() != "tpu"
+    return bool(interpret)
+
+
+def fit_block(block: int, size: int, what: str = "dimension") -> int:
+    """Largest usable block: min(block, size), reduced to a divisor of
+    ``size`` (gcd) so sizes that worked at small defaults keep working at
+    larger tuned defaults.  Degenerate sizes (divisor < 8 sublanes) are
+    rejected."""
+    b = min(block, size)
+    if size % b:
+        b = math.gcd(size, b)
+    if b < 8:
+        raise ValueError(
+            f"{what} {size} has no usable block (gcd with {block} is "
+            f"{b} < 8); pass an explicit block size dividing it")
+    return b

@@ -34,7 +34,6 @@ Layout convention matches the rest of the stack: ``[B, T, H, D]``.
 from __future__ import annotations
 
 import functools
-import math
 from typing import Optional
 
 import jax
@@ -42,6 +41,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from ._pallas_utils import fit_block as _fit_block_impl, resolve_interpret
 
 # Tuned on TPU v5e at T=4096, H=12, D=64 bf16: (512, 1024) is 4x faster
 # than (128, 128) — big k blocks amortize grid-step overhead and keep the
@@ -53,26 +54,11 @@ _NEG_INF = -1e30
 
 
 def _resolve_interpret(interpret) -> bool:
-    """None = auto: interpret mode off TPU (CPU tests / virtual meshes),
-    compiled Mosaic kernels on TPU."""
-    if interpret is None:
-        return jax.default_backend() != "tpu"
-    return bool(interpret)
+    return resolve_interpret(interpret)
 
 
 def _fit_block(block: int, T: int) -> int:
-    """Largest usable block size: min(block, T), reduced to a divisor of T
-    (gcd) so any T that worked at the old 128 defaults still works at the
-    larger tuned defaults.  Degenerate T (gcd < 8 sublanes) is rejected
-    with the same error the caller raised historically."""
-    b = min(block, T)
-    if T % b:
-        b = math.gcd(T, b)
-    if b < 8:
-        raise ValueError(
-            f"seq len {T} has no usable flash block (gcd with {block} is "
-            f"{b} < 8); pass block_q/block_k dividing the sequence length")
-    return b
+    return _fit_block_impl(block, T, what="seq len")
 
 
 def _causal_last_k(qi, block_q: int, block_k: int, nk: int):
