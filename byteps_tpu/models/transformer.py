@@ -165,27 +165,45 @@ class Block(nn.Module):
 
 
 class Transformer(nn.Module):
-    """Causal LM.  Input ``tokens [B, T]`` -> logits ``[B, T, vocab]``."""
+    """Causal LM.  Input ``tokens [B, T]`` -> logits ``[B, T, vocab]``.
+
+    setup()-style (not compact) so ``hidden`` can be called as a separate
+    method: the fused LM-head cross-entropy path
+    (ops/fused_cross_entropy.py, training.lm_loss_fn) consumes the
+    pre-head hidden states and the ``lm_head`` kernel directly, never
+    materializing the [B, T, vocab] logits.  Parameter tree is identical
+    to the previous compact form (embed / pos / block_i / ln_f / lm_head).
+    """
 
     cfg: TransformerConfig
 
-    @nn.compact
-    def __call__(self, tokens):
+    def setup(self):
         cfg = self.cfg
-        x = nn.Embed(
+        self.embed = nn.Embed(
             cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, name="embed",
             embedding_init=cfg.partition(
                 nn.initializers.normal(stddev=0.02), (None, None)
             ),
-        )(tokens)
-        pos = nn.Embed(
+        )
+        self.pos = nn.Embed(
             cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype, name="pos",
-        )(jnp.arange(tokens.shape[1])[None, :])
-        x = x + pos
-        for i in range(cfg.num_layers):
-            x = Block(cfg, name=f"block_{i}")(x)
-        x = nn.RMSNorm(dtype=cfg.dtype, name="ln_f")(x)
-        logits = nn.Dense(
+        )
+        self.blocks = [
+            Block(cfg, name=f"block_{i}") for i in range(cfg.num_layers)
+        ]
+        self.ln_f = nn.RMSNorm(dtype=cfg.dtype, name="ln_f")
+        self.lm_head = nn.Dense(
             cfg.vocab_size, dtype=jnp.float32, use_bias=False, name="lm_head",
-        )(x)
-        return logits.astype(jnp.float32)
+        )
+
+    def hidden(self, tokens):
+        """Everything up to (and including) the final norm:
+        ``[B, T] -> [B, T, d_model]``."""
+        x = self.embed(tokens)
+        x = x + self.pos(jnp.arange(tokens.shape[1])[None, :])
+        for block in self.blocks:
+            x = block(x)
+        return self.ln_f(x)
+
+    def __call__(self, tokens):
+        return self.lm_head(self.hidden(tokens)).astype(jnp.float32)

@@ -7,6 +7,7 @@ from .trainer import Trainer
 from .step import (
     TrainState,
     classification_loss_fn,
+    lm_loss_fn,
     create_train_state,
     make_data_parallel_step,
     replicate_state,
@@ -16,6 +17,6 @@ from .step import (
 __all__ = [
     "DistributedOptimizer", "push_pull_gradients",
     "TrainState", "create_train_state", "make_data_parallel_step",
-    "shard_batch", "replicate_state", "classification_loss_fn",
+    "shard_batch", "replicate_state", "classification_loss_fn", "lm_loss_fn",
     "OverlapState", "make_delayed_grad_step", "Trainer",
 ]

@@ -160,6 +160,49 @@ def replicate_state(state, mesh: Mesh):
     )
 
 
+def lm_loss_fn(model, fused_head: bool = False,
+               block_n: Optional[int] = None, block_v: Optional[int] = None):
+    """Next-token cross-entropy loss closure for a causal LM whose batch
+    is ``{"tokens": [B, T]}``; fits ``make_data_parallel_step``.
+
+    ``fused_head=True`` routes through the Pallas fused LM-head kernel
+    (ops/fused_cross_entropy.py): the model's ``hidden`` method supplies
+    pre-head states and the ``lm_head`` kernel multiplies inside the
+    fused op — the [B, T, vocab] logits never materialize.  The full
+    B*T rows go to the kernel (keeping N block-divisible for typical
+    sequence lengths); the shift-off last position rides the kernel's
+    ignore-index semantics (target -1 → loss 0, no grad).  Requires a
+    model exposing ``hidden`` and an ``lm_head`` Dense (models/
+    transformer.Transformer does).  ``block_n``/``block_v`` pass through
+    to the kernel for vocab/batch sizes its auto-fit cannot divide
+    (e.g. GPT-2's 50257).
+    """
+
+    def loss_fn(params, model_state, batch):
+        tokens = batch["tokens"]
+        targets = jnp.roll(tokens, -1, axis=1)
+        if fused_head:
+            from ..ops.fused_cross_entropy import fused_linear_cross_entropy
+
+            h = model.apply({"params": params}, tokens, method=model.hidden)
+            w = params["lm_head"]["kernel"].astype(h.dtype)
+            B, T, d = h.shape
+            targets = targets.at[:, -1].set(-1)  # ignore the wrap position
+            per_row = fused_linear_cross_entropy(
+                h.reshape(-1, d), w, targets.reshape(-1),
+                block_n, block_v,
+            )
+            loss = per_row.sum() / (B * (T - 1))
+        else:
+            logits = model.apply({"params": params}, tokens)
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], targets[:, :-1]
+            ).mean()
+        return loss, model_state
+
+    return loss_fn
+
+
 def classification_loss_fn(model, train: bool = True, rngs_fn=None):
     """Standard softmax-CE loss closure for a flax vision model with
     (optional) BatchNorm state; fits ``make_data_parallel_step``."""

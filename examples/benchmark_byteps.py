@@ -23,6 +23,7 @@ import byteps_tpu as bps
 from byteps_tpu.models import ResNet50, VGG16, Transformer, TransformerConfig
 from byteps_tpu.training import (
     classification_loss_fn,
+    lm_loss_fn,
     make_data_parallel_step,
     shard_batch,
 )
@@ -60,21 +61,17 @@ def build_vision(args, mesh):
 
 def build_transformer(args, mesh):
     cfg = TransformerConfig(
-        vocab_size=32000, num_layers=12, num_heads=12, d_model=768,
-        d_ff=3072, max_seq_len=args.seq_len,
+        vocab_size=args.vocab_size, num_layers=args.num_layers,
+        num_heads=args.num_heads, d_model=args.d_model,
+        d_ff=4 * args.d_model, max_seq_len=args.seq_len,
         dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
+        attn_impl=args.attn,
     )
     model = Transformer(cfg)
     tokens0 = jnp.zeros((args.batch_size, args.seq_len), jnp.int32)
     variables = model.init(jax.random.PRNGKey(0), tokens0)
 
-    def loss_fn(params, model_state, batch):
-        logits = model.apply({"params": params}, batch["tokens"])
-        targets = jnp.roll(batch["tokens"], -1, axis=1)
-        loss = optax.softmax_cross_entropy_with_integer_labels(
-            logits[:, :-1], targets[:, :-1]
-        ).mean()
-        return loss, model_state
+    loss_fn = lm_loss_fn(model, fused_head=args.fused_head)
 
     tx = optax.adamw(1e-4)
     step = make_data_parallel_step(
@@ -86,7 +83,7 @@ def build_transformer(args, mesh):
     n = args.batch_size * bps.size()
     batch = shard_batch(
         {"tokens": jax.random.randint(
-            jax.random.PRNGKey(1), (n, args.seq_len), 0, 32000)},
+            jax.random.PRNGKey(1), (n, args.seq_len), 0, args.vocab_size)},
         mesh,
     )
     return step, state, batch, n
@@ -103,6 +100,16 @@ def main():
     p.add_argument("--num-warmup", type=int, default=5)
     p.add_argument("--num-iters", type=int, default=30)
     p.add_argument("--bf16", action="store_true")
+    p.add_argument("--num-layers", type=int, default=12)
+    p.add_argument("--num-heads", type=int, default=12)
+    p.add_argument("--d-model", type=int, default=768)
+    p.add_argument("--vocab-size", type=int, default=32000)
+    p.add_argument("--attn", default="local", choices=["local", "flash"],
+                   help="attention impl for --model transformer "
+                        "(flash = the Pallas kernel)")
+    p.add_argument("--fused-head", action="store_true",
+                   help="fused LM-head cross-entropy (Pallas; no [B,T,V] "
+                        "logits materialization)")
     p.add_argument("--partition-bytes", type=int, default=4_096_000)
     args = p.parse_args()
 
