@@ -11,10 +11,13 @@ from .resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101, ResNet152
 from .vgg import VGG, VGG11, VGG16, VGG19
 from .transformer import Transformer, TransformerConfig
 from .bert import BertClassifier, BertEncoder, BertMLM, bert_config
+from .mobilenet import MobileNetV2
+from .classic import AlexNet, LeNet
 
 __all__ = [
     "ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101", "ResNet152",
     "VGG", "VGG11", "VGG16", "VGG19",
     "Transformer", "TransformerConfig",
     "BertEncoder", "BertClassifier", "BertMLM", "bert_config",
+    "MobileNetV2", "AlexNet", "LeNet",
 ]

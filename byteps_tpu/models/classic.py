@@ -1,0 +1,59 @@
+"""Classic CNNs from the reference's model zoo — LeNet and AlexNet
+(example/mxnet/symbols/lenet.py, alexnet.py).  Small but kept for zoo
+parity and as minimal end-to-end models for tests/tutorials; NHWC,
+configurable compute dtype like the rest of models/."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LeNet(nn.Module):
+    """LeNet-5-style: 2 conv/pool stages + 2 dense layers."""
+
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        del train  # no BN/dropout; accepted for loss_fn uniformity
+        x = nn.Conv(32, (5, 5), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(nn.Dense(512, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(
+            x.astype(jnp.float32))
+
+
+class AlexNet(nn.Module):
+    """AlexNet (one-tower variant), 224x224 inputs."""
+
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = lambda f, k, s=1, p="SAME": nn.Conv(  # noqa: E731
+            f, (k, k), strides=(s, s), padding=p, dtype=self.dtype)
+        x = nn.relu(conv(64, 11, 4)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(conv(192, 5)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(conv(384, 3)(x))
+        x = nn.relu(conv(256, 3)(x))
+        x = nn.relu(conv(256, 3)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(
+            x.astype(jnp.float32))

@@ -1,0 +1,95 @@
+"""MobileNetV2 — part of the reference's model zoo
+(example/mxnet/symbols/mobilenetv2.py trains through its fit_byteps
+harness).  TPU-first notes:
+
+  * NHWC; depthwise convolutions via ``feature_group_count`` — XLA lowers
+    them to the VPU (they are bandwidth-bound, not MXU work), while the
+    1x1 expand/project convs are plain MXU matmuls,
+  * channel counts kept at multiples of 8 so the lane tiling stays clean,
+  * BatchNorm running stats in a mutable collection like models/resnet.py
+    (per-replica semantics; caller syncs across dp if desired).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def _make_divisible(v: float, divisor: int = 8) -> int:
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:  # standard tf-slim rounding rule
+        new_v += divisor
+    return new_v
+
+
+class InvertedResidual(nn.Module):
+    """expand (1x1) -> depthwise (3x3) -> project (1x1), linear output."""
+
+    filters: int
+    strides: int
+    expand: int
+    conv: Any
+    norm: Any
+
+    @nn.compact
+    def __call__(self, x):
+        inp = x.shape[-1]
+        hidden = inp * self.expand
+        y = x
+        if self.expand != 1:
+            y = self.conv(hidden, (1, 1))(y)
+            y = nn.relu6(self.norm()(y))
+        y = self.conv(hidden, (3, 3), strides=(self.strides, self.strides),
+                      feature_group_count=hidden)(y)
+        y = nn.relu6(self.norm()(y))
+        y = self.conv(self.filters, (1, 1))(y)
+        y = self.norm()(y)  # linear bottleneck: no activation
+        if self.strides == 1 and inp == self.filters:
+            y = x + y
+        return y
+
+
+# (expand, filters, repeats, first-stride) per stage — the V2 paper table
+_V2_STAGES: Sequence[Tuple[int, int, int, int]] = (
+    (1, 16, 1, 1),
+    (6, 24, 2, 2),
+    (6, 32, 3, 2),
+    (6, 64, 4, 2),
+    (6, 96, 3, 1),
+    (6, 160, 3, 2),
+    (6, 320, 1, 1),
+)
+
+
+class MobileNetV2(nn.Module):
+    num_classes: int = 1000
+    width_mult: float = 1.0
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = functools.partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-3, dtype=self.dtype,
+        )
+        c = _make_divisible(32 * self.width_mult)
+        x = conv(c, (3, 3), strides=(2, 2))(x)
+        x = nn.relu6(norm()(x))
+        for expand, filters, repeats, stride in _V2_STAGES:
+            f = _make_divisible(filters * self.width_mult)
+            for i in range(repeats):
+                x = InvertedResidual(
+                    filters=f, strides=stride if i == 0 else 1,
+                    expand=expand, conv=conv, norm=norm,
+                )(x)
+        last = _make_divisible(1280 * max(1.0, self.width_mult))
+        x = conv(last, (1, 1))(x)
+        x = nn.relu6(norm()(x))
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="head")(x.astype(jnp.float32))

@@ -86,3 +86,47 @@ def test_transformer_flash_sp_composes():
         )(variables, tokens)
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
                                atol=3e-5, rtol=3e-5)
+
+
+def test_mobilenet_v2_forward_and_train_step():
+    from byteps_tpu.models import MobileNetV2
+    from byteps_tpu.training import (
+        classification_loss_fn, make_data_parallel_step, shard_batch)
+    from jax.sharding import Mesh
+    import optax
+
+    model = MobileNetV2(num_classes=10, width_mult=0.25, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(1), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (2, 10)
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    n = 2 * len(jax.devices())
+    step = make_data_parallel_step(
+        classification_loss_fn(model), optax.sgd(0.05), mesh)
+    state = step.init_state(
+        variables["params"],
+        model_state={"batch_stats": variables["batch_stats"]})
+    batch = shard_batch(
+        {"image": jax.random.normal(jax.random.PRNGKey(2), (n, 32, 32, 3)),
+         "label": jax.random.randint(jax.random.PRNGKey(3), (n,), 0, 10)},
+        mesh)
+    state, metrics = step(state, batch)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_lenet_alexnet_forward():
+    from byteps_tpu.models import AlexNet, LeNet
+
+    lenet = LeNet(num_classes=10)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 28, 28, 1))
+    v = lenet.init(jax.random.PRNGKey(1), x)
+    assert lenet.apply(v, x).shape == (2, 10)
+
+    alex = AlexNet(num_classes=100, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 64, 64, 3))
+    v = alex.init({"params": jax.random.PRNGKey(1),
+                   "dropout": jax.random.PRNGKey(2)}, x)
+    out = alex.apply(v, x, train=False)
+    assert out.shape == (2, 100)
