@@ -99,7 +99,7 @@ def test_distributed_optimizer_trains():
         loss = torch.nn.functional.mse_loss(model(x), y)
         loss.backward()
         opt.step()
-    assert float(loss) < 1e-3
+    assert float(loss.detach()) < 1e-3
     torch.testing.assert_close(model.weight.detach(), w_true,
                                rtol=0.05, atol=0.05)
 
