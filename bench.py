@@ -47,7 +47,8 @@ from byteps_tpu.training import (
 from byteps_tpu.training.step import replicate_state
 
 WARMUP = 5
-ITERS = 30
+ITERS = 30      # per timed chunk (scaled down in CPU smoke mode)
+REPEATS = 4     # interleaved best-of-N chunks
 
 # bf16 MXU peak per chip (TFLOP/s), keyed by substring of device_kind.
 # Sources: public TPU spec sheets; used only for the MFU denominator.
@@ -95,11 +96,14 @@ def _time_chunk(fn, state, batch, iters):
     return (time.perf_counter() - t0) / iters, state
 
 
-def _time_pair(fn_a, state_a, fn_b, state_b, batch, iters=ITERS, repeats=4):
+def _time_pair(fn_a, state_a, fn_b, state_b, batch, iters=None,
+               repeats=None):
     """Time two programs on the same inputs with *interleaved* best-of-N
     chunks: alternating a/b chunks cancels slow drift (chip clocks, tunnel
     warm-up) that back-to-back timing folds into whichever runs second;
     min is the noise-robust estimator for a deterministic program."""
+    iters = ITERS if iters is None else iters
+    repeats = REPEATS if repeats is None else repeats
     for _ in range(WARMUP):
         state_a, ma = fn_a(state_a, batch)
         state_b, mb = fn_b(state_b, batch)
@@ -149,7 +153,7 @@ def _deep_copy(tree):
 
 def _run_config(name, unit, per_item_scale, model, loss_fn, tx, mesh, batch,
                 batch_size, analytic_flops_per_item, init_args, init_kwargs,
-                iters=ITERS):
+                iters=None):
     """Build framework + plain states, time both, return the result dict.
 
     ``per_item_scale`` converts items/step (batch rows) to the reported
@@ -201,7 +205,10 @@ def _run_config(name, unit, per_item_scale, model, loss_fn, tx, mesh, batch,
 
 
 def main():
+    global ITERS, REPEATS
     on_tpu = jax.devices()[0].platform == "tpu"
+    if not on_tpu:  # CPU smoke: keep the whole matrix under a few minutes
+        ITERS, REPEATS = 5, 2
     n_dev = len(jax.devices())
     mesh = Mesh(np.array(jax.devices()), ("dp",))
     results = []
@@ -345,7 +352,7 @@ def main():
         return fn
 
     t_flash, t_naive = _time_pair(
-        attn_step("flash"), None, attn_step("naive"), None, qkv, ITERS)
+        attn_step("flash"), None, attn_step("naive"), None, qkv)
     # attention FLOPs: fwd = 2 matmuls * 2*B*H*T^2*D, halved by causal
     # masking; bwd ~ 2.5x fwd (4 matmuls + recompute) => total 3.5x
     flops = 3.5 * (2 * 2 * fb * fH * fT * fT * fD * 0.5)
