@@ -46,9 +46,9 @@ from byteps_tpu.training import (
 )
 from byteps_tpu.training.step import replicate_state
 
-WARMUP = 5
+WARMUP = 3      # post-AOT-compile warmup (runtime path only)
 ITERS = 30      # per timed chunk (scaled down in CPU smoke mode)
-REPEATS = 4     # interleaved best-of-N chunks
+REPEATS = 3     # interleaved best-of-N chunks
 
 # bf16 MXU peak per chip (TFLOP/s), keyed by substring of device_kind.
 # Sources: public TPU spec sheets; used only for the MFU denominator.
