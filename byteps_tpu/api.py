@@ -29,7 +29,7 @@ import numpy as np
 from .common import logging as bps_log
 from .common.config import get_config, reset_config
 from .engine import dispatcher as _dispatcher
-from .ops.compression import Compression, Compressor, NoneCompressor
+from .ops.compression import Compression
 from .parallel import collectives as _collectives
 from .parallel import mesh as _mesh_mod
 

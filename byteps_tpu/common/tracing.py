@@ -23,7 +23,7 @@ import os
 import threading
 import time
 from contextlib import contextmanager
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 from .config import get_config
 

@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import queue as queue_mod
 import threading
-from typing import Any, Callable, Dict, List, Optional
+from typing import Callable, List, Optional
 
 import jax
 import jax.numpy as jnp

@@ -9,8 +9,7 @@ Built from the same Block stack as the decoder (models/transformer.py) with
 
 from __future__ import annotations
 
-import dataclasses
-from typing import Any, Optional
+from typing import Any
 
 import flax.linen as nn
 import jax

@@ -21,7 +21,7 @@ counts < 2^15, so no cross-worker requantization error accumulates.
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Optional, Sequence, Union
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp

@@ -18,7 +18,7 @@ warmup callback implements: lr ramps from ``initial_lr`` to
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Optional, Sequence, Union
+from typing import Callable, Dict, Optional, Union
 
 import jax
 import jax.numpy as jnp

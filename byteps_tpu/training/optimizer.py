@@ -23,7 +23,7 @@ import jax
 import optax
 
 from ..common.config import get_config
-from ..common.partition import BucketPlan, plan_buckets
+from ..common.partition import BucketPlan
 from ..ops.compression import Compression
 from ..parallel.collectives import push_pull_tree
 

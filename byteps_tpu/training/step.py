@@ -15,7 +15,7 @@ with these same pieces (see models/transformer.py and __graft_entry__.py).
 
 from __future__ import annotations
 
-from typing import Any, Callable, NamedTuple, Optional, Sequence, Tuple
+from typing import Any, Callable, NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -23,7 +23,6 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..common.config import get_config
-from ..common.partition import plan_buckets
 from ..ops.compression import Compression
 from .optimizer import DistributedOptimizer
 from ..parallel.collectives import shard_map
