@@ -18,7 +18,7 @@ import jax.numpy as jnp
 
 import byteps_tpu as bps
 from byteps_tpu.models import Transformer, TransformerConfig
-from byteps_tpu.ops import fused_linear_cross_entropy
+from byteps_tpu.training import lm_loss_fn
 
 
 def main():
@@ -44,28 +44,27 @@ def main():
         jax.random.PRNGKey(0),
         jnp.zeros((args.batch_size, args.seq_len), jnp.int32))["params"]
 
-    @jax.jit
-    def nll(params, tokens):
-        """Summed next-token NLL + token count, via hidden states + the
-        fused kernel — no [B, T, vocab] logits buffer."""
-        h = model.apply({"params": params}, tokens, method=model.hidden)
-        w = params["lm_head"]["kernel"].astype(h.dtype)
-        targets = jnp.roll(tokens, -1, axis=1).at[:, -1].set(-1)
-        per_row = fused_linear_cross_entropy(
-            h.reshape(-1, h.shape[-1]), w, targets.reshape(-1))
-        count = tokens.shape[0] * (tokens.shape[1] - 1)
-        return per_row.sum(), count
+    # the library's fused LM-head loss path (training.lm_loss_fn):
+    # hidden states + lm_head kernel into the Pallas kernel, no
+    # [B, T, vocab] logits buffer; mean is over B*(T-1) real targets
+    loss_fn = jax.jit(
+        lambda p, tokens: lm_loss_fn(model, fused_head=True)(
+            p, {}, {"tokens": tokens})[0])
+
+    def batch(i):
+        # synthetic eval stream (swap for real token batches)
+        return jax.random.randint(
+            jax.random.PRNGKey(i),
+            (args.batch_size, args.seq_len), 0, cfg.vocab_size)
+
+    per_batch = args.batch_size * (args.seq_len - 1)
+    float(loss_fn(params, batch(0)))  # warmup: compile outside the timing
 
     total_nll, total_tokens = 0.0, 0
     t0 = time.time()
     for i in range(args.batches):
-        # synthetic eval stream (swap for real token batches)
-        tokens = jax.random.randint(
-            jax.random.PRNGKey(i),
-            (args.batch_size, args.seq_len), 0, cfg.vocab_size)
-        s, c = nll(params, tokens)
-        total_nll += float(s)
-        total_tokens += c
+        total_nll += float(loss_fn(params, batch(i))) * per_batch
+        total_tokens += per_batch
     dt = time.time() - t0
     ppl = math.exp(total_nll / total_tokens)
     print(f"perplexity {ppl:.2f} over {total_tokens} tokens "

@@ -62,3 +62,48 @@ def test_engine_emits_trace(tmp_path):
     assert any("traced_tensor" in s for s in names)
     stages = {e["tid"] for e in data["traceEvents"]}
     assert {"dispatch", "push_pull"} <= stages
+
+
+def test_debug_sample_tensor_logs(monkeypatch):
+    """BYTEPS_DEBUG_SAMPLE_TENSOR=<name> prints the tensor's first/last
+    values after the stage completes (reference core_loops.cc:33-63)."""
+    import logging
+
+    import numpy as np
+
+    import byteps_tpu as bps
+
+    bps.shutdown()  # drop engine + config so the env var is re-read
+    monkeypatch.setenv("BYTEPS_DEBUG_SAMPLE_TENSOR", "dbg_probe")
+    bps.init()
+    # the byteps_tpu logger doesn't propagate and caches its level from
+    # the first init; attach a handler + raise the level directly
+    logger = logging.getLogger("byteps_tpu")
+    messages = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            messages.append(record.getMessage())
+
+    handler = _Capture(level=logging.INFO)
+    old_level = logger.level
+    logger.addHandler(handler)
+    logger.setLevel(logging.INFO)
+    try:
+        n = bps.size()
+        x = np.arange(n * 4, dtype=np.float32).reshape(n, 4)
+        out = bps.push_pull(x, average=False, name="dbg_probe_w")
+        np.asarray(out)
+        assert any("sample dbg_probe_w" in m for m in messages), messages
+        # non-matching names stay silent
+        messages.clear()
+        bps.push_pull(x, average=False, name="other_tensor")
+        assert not any("sample other" in m for m in messages)
+    finally:
+        logger.removeHandler(handler)
+        logger.setLevel(old_level)
+        # undo the env BEFORE re-init, or the sampling config leaks into
+        # the restored engine for the rest of the session
+        monkeypatch.delenv("BYTEPS_DEBUG_SAMPLE_TENSOR", raising=False)
+        bps.shutdown()
+        bps.init()  # restore a clean engine for subsequent tests
