@@ -180,6 +180,8 @@ class NativeLoader:
                 lptr = ctypes.c_void_p()
                 slot = lib.bps_loader_acquire(
                     self._handle, ctypes.byref(dptr), ctypes.byref(lptr))
+                if slot < 0:  # loader shut down while we were blocked
+                    raise RuntimeError("NativeLoader is closed")
                 out_dtype = np.float32 if self._mode == 1 else np.uint8
                 nbytes = (self.batch_size * self._sample_bytes *
                           np.dtype(out_dtype).itemsize)

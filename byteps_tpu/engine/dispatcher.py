@@ -118,12 +118,19 @@ class Engine:
         version: int = 0,
         wire_dtype=None,
         postprocess: Optional[Callable] = None,
+        identity: bool = False,
     ) -> int:
         """Enqueue an allreduce of stacked per-worker contributions.
 
         ``stacked`` has shape [world, ...] — worker w's tensor at index w
         (single-controller rendering of per-rank push_pull; see
         parallel/collectives.py).  Returns a handle for poll/synchronize.
+
+        ``identity=True`` enqueues a one-worker task (stacked is [1, ...])
+        regardless of the mesh world — used by process-level front-ends
+        (byteps_tpu.torch hooks) whose worker count is the process count,
+        so the task rides the priority/credit queue without a device
+        collective.
         """
         cfg = get_config()
         ctx = self.registry.declare(name)
@@ -133,7 +140,7 @@ class Engine:
             wire_dtype = cfg.wire_jnp_dtype
         out_shape = stacked.shape[1:]
         out_dtype = stacked.dtype
-        flat = stacked.reshape(self.world, -1)
+        flat = stacked.reshape(1 if identity else self.world, -1)
         nbytes_per_worker = flat.shape[1] * flat.dtype.itemsize
         parts = partition_offsets(nbytes_per_worker, cfg.effective_partition_bytes)
         itemsize = flat.dtype.itemsize
@@ -164,6 +171,7 @@ class Engine:
             task.request = req  # type: ignore[attr-defined]
             task.average = average  # type: ignore[attr-defined]
             task.wire_dtype = wire_dtype  # type: ignore[attr-defined]
+            task.identity = identity  # type: ignore[attr-defined]
             self.queue.add_task(task)
         return handle
 
@@ -215,7 +223,7 @@ class Engine:
                 self.queue.report_finish(task)
 
     def _launch(self, task: TensorTaskEntry) -> jax.Array:
-        if self.world == 1:
+        if self.world == 1 or getattr(task, "identity", False):
             return task.payload[0]
         return collectives.push_pull_stacked(
             task.payload,

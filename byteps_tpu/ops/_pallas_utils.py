@@ -20,12 +20,29 @@ def fit_block(block: int, size: int, what: str = "dimension") -> int:
     """Largest usable block: min(block, size), reduced to a divisor of
     ``size`` (gcd) so sizes that worked at small defaults keep working at
     larger tuned defaults.  Degenerate sizes (divisor < 8 sublanes) are
-    rejected."""
+    rejected.
+
+    Block size dominates kernel throughput (an order of magnitude between
+    block 8 and block 512 at the same shape), so a silent gcd fallback to
+    a tiny block is a footgun: sizes whose resolved block is much smaller
+    than requested warn with the padding remedy.  Sizes coprime to every
+    usable block (e.g. GPT-2's 50257 vocab) raise — pad the dimension to
+    a multiple of 128 (or pass an explicit dividing block) instead.
+    """
     b = min(block, size)
     if size % b:
         b = math.gcd(size, b)
     if b < 8:
         raise ValueError(
             f"{what} {size} has no usable block (gcd with {block} is "
-            f"{b} < 8); pass an explicit block size dividing it")
+            f"{b} < 8); pad {what} to a multiple of 128 (or pass an "
+            f"explicit block size dividing it)")
+    if b * 4 <= min(block, size):
+        from ..common import logging as bps_log
+
+        bps_log.warning(
+            "%s %d is indivisible by the requested block %d; falling back "
+            "to block %d, which can cost substantial kernel throughput — "
+            "pad %s to a multiple of 128 or pass an explicit block size",
+            what, size, block, b, what)
     return b

@@ -11,11 +11,16 @@ launched through ``bpslaunch``/`jax.distributed`.
 
 Differences from the reference, by design:
   * no CUDA ready-events — torch CPU tensors are ready when passed;
-  * ``DistributedOptimizer`` communicates at ``step()`` rather than from
-    autograd hooks: on a CPU front-end there is no backward/comm overlap
-    to win, and synchronous-at-step keeps torch's autograd untouched.
-    ``backward_passes_per_step`` accumulates locally exactly like the
-    reference (torch/__init__.py:107-154).
+  * ``DistributedOptimizer`` registers per-parameter autograd hooks
+    (``register_post_accumulate_grad_hook`` — the official form of the
+    reference's grad-accumulator hook, torch/__init__.py:112-154) that
+    enqueue each gradient's push_pull *as backward produces it*; ``step()``
+    synchronizes.  Single-process, the tasks ride the eager engine's
+    priority/credit ScheduledQueue; multi-process, each hook enters the
+    SPMD reduce program directly (async XLA dispatch — completion is
+    lazy), which requires the backward order — i.e. the model — to be
+    identical on every process, the same constraint the reference's
+    declared-tensor contract imposes.
 """
 
 from __future__ import annotations
@@ -197,19 +202,62 @@ def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
     optimizer.load_state_dict(state_dict)
 
 
+def _engine_push_pull_async_inplace(tensor, name: str,
+                                    compression: type) -> int:
+    """Single-process hook path: enqueue an identity-reduce task on the
+    eager engine's ScheduledQueue (priority = -declared key, credit-gated,
+    drained by the dispatcher thread) and register the torch tensor for
+    in-place write-back at synchronize.  This is the runtime customer of
+    the priority queue the reference's grad-accumulator hooks feed
+    (torch/__init__.py:112-154): with one process there is no wire
+    traffic, but the task flows dispatch → completion asynchronously
+    while backward keeps running."""
+    import jax.numpy as jnp
+
+    from ..engine import dispatcher as _dispatcher
+
+    engine = _dispatcher.get_engine()
+    wire = getattr(compression, "wire_dtype", None)
+    arr = jnp.asarray(_to_np(tensor))
+    handle = engine.push_pull_async(
+        arr[None], name, average=True, identity=True,
+        wire_dtype=np.dtype(wire) if wire is not None else None,
+    )
+    with _handles_lock:
+        _handles[handle] = (tensor, True)
+    return handle
+
+
 def DistributedOptimizer(optimizer, named_parameters: Optional[
         Iterable[Tuple[str, Any]]] = None,
         compression: type = Compression.none,
         backward_passes_per_step: int = 1):
-    """Wrap a ``torch.optim.Optimizer`` so ``step()`` push_pulls (averages)
-    every parameter's gradient across workers first — the reference's
-    dynamic-subclassing factory (torch/__init__.py:226-231, 383-402).
+    """Wrap a ``torch.optim.Optimizer`` so every parameter's gradient is
+    push_pulled (averaged) across workers — the reference's
+    dynamic-subclassing factory (torch/__init__.py:226-231, 383-402),
+    including its hook protocol:
 
-    Gradient names follow the reference's ``Gradient.<name>`` convention
-    (sorted for key load-balance, torch/__init__.py:90-95); anonymous
-    parameters get positional names.
+      * a per-parameter autograd hook fires as backward accumulates each
+        gradient; on the ``backward_passes_per_step``-th pass it enqueues
+        the async push_pull (torch/__init__.py:140-154) — communication
+        overlaps the rest of backward;
+      * ``synchronize()`` waits for every in-flight reduce, writes the
+        averaged gradients back in place, and re-arms the per-parameter
+        delay counters (torch/__init__.py:155-170).  Public, for
+        gradient clipping between backward and ``step()``;
+      * ``step()`` = ``synchronize()`` + the wrapped optimizer's step.
+
+    Contract notes (all reference-parity): gradients accumulated over k
+    backward passes are communicated as their *sum* (no division by k);
+    calling backward more than ``backward_passes_per_step`` times before
+    ``step()`` raises; an early ``step()`` reduces whatever has
+    accumulated.  Gradient names follow the reference's
+    ``Gradient.<name>`` convention (sorted declaration for key
+    load-balance, torch/__init__.py:90-95); anonymous parameters get
+    positional names.
     """
     torch = _torch()
+    import jax
 
     if named_parameters is not None:
         named = list(named_parameters)
@@ -227,39 +275,106 @@ def DistributedOptimizer(optimizer, named_parameters: Optional[
         def __init__(self):  # never called; state comes from the instance
             pass
 
-        def _grad_names(self):
+        def _bps_setup(self):
+            self._bps_passes = backward_passes_per_step
+            self._bps_handles: Dict[Any, Optional[int]] = {}
+            self._bps_delay: Dict[Any, int] = {}
+            self._bps_requires_update = set()
+            self._bps_hook_refs = []
+            self._bps_names = {}
             idx = 0
             for group in self.param_groups:
                 for p in group["params"]:
-                    name = name_of.get(id(p), f"param_{idx}")
-                    yield name, p
+                    self._bps_names[p] = name_of.get(id(p), f"param_{idx}")
                     idx += 1
+            # sorted declaration == deterministic keys == reference
+            # priorities (earlier names drain first via -declared_key)
+            for nm in sorted(self._bps_names.values()):
+                _api.declare(f"Gradient.{nm}")
+            post_hook = hasattr(torch.Tensor,
+                                "register_post_accumulate_grad_hook")
+            for group in self.param_groups:
+                for p in group["params"]:
+                    if not p.requires_grad:
+                        continue
+                    if p.grad is None:
+                        p.grad = torch.zeros_like(p)
+                    self._bps_requires_update.add(p)
+                    self._bps_delay[p] = self._bps_passes
+                    if post_hook:
+                        self._bps_hook_refs.append(
+                            p.register_post_accumulate_grad_hook(
+                                self._bps_make_hook(p)))
+                    else:  # pragma: no cover - torch < 2.1
+                        # plain tensor hooks fire *before* accumulation,
+                        # so only count there; comm happens at synchronize
+                        self._bps_hook_refs.append(p.register_hook(
+                            self._bps_make_hook(p, count_only=True)))
+
+        def _bps_make_hook(self, p, count_only: bool = False):
+            def hook(*ignore):
+                if self._bps_delay[p] <= 0:
+                    # raising from inside an autograd hook can terminate
+                    # the process (exceptions may not propagate out of
+                    # the C++ engine); record and raise at synchronize()
+                    self._bps_excess = True
+                    return
+                self._bps_delay[p] -= 1
+                handle = None
+                if self._bps_delay[p] == 0 and not count_only:
+                    handle = self._bps_push_pull_grad_async(p)
+                self._bps_handles[p] = handle
+            return hook
+
+        def _bps_push_pull_grad_async(self, p) -> int:
+            name = f"Gradient.{self._bps_names[p]}"
+            if p.grad is None:  # zeroed with set_to_none before any pass
+                p.grad = torch.zeros_like(p)
+            if jax.process_count() > 1:
+                # SPMD reduce entered at hook time; XLA dispatch is async
+                # so completion overlaps the rest of backward
+                return push_pull_async_inplace(
+                    p.grad, average=True, name=name, compression=compression)
+            return _engine_push_pull_async_inplace(p.grad, name, compression)
+
+        def set_backward_passes_per_step(self, passes: int):
+            """Reference torch/__init__.py:106-110."""
+            self._bps_passes = passes
+            for p in self._bps_delay:
+                self._bps_delay[p] = passes
+
+        def synchronize(self):
+            if getattr(self, "_bps_excess", False):
+                self._bps_excess = False
+                raise AssertionError(
+                    "Gradients were computed more than "
+                    "backward_passes_per_step times before call to "
+                    "step(). Increase backward_passes_per_step to "
+                    "accumulate gradients locally.  (Closure-based "
+                    "optimizers that re-run backward inside step(), "
+                    "e.g. LBFGS, are unsupported — as in the "
+                    "reference.)")
+            # params whose hook never fired this step (sorted: collective
+            # issue order must be deterministic across processes)
+            missing = self._bps_requires_update - set(self._bps_handles)
+            for p in sorted(missing, key=lambda q: self._bps_names[q]):
+                self._bps_handles[p] = self._bps_push_pull_grad_async(p)
+            for p, h in list(self._bps_handles.items()):
+                if h is None:  # hook fired but under the delay threshold
+                    self._bps_handles[p] = self._bps_push_pull_grad_async(p)
+            for p, h in self._bps_handles.items():
+                synchronize(h)  # module-level: writes back into p.grad
+                self._bps_delay[p] = self._bps_passes
+            self._bps_handles.clear()
 
         def step(self, closure=None):
-            self._bps_accum = getattr(self, "_bps_accum", 0) + 1
-            if self._bps_accum >= backward_passes_per_step:
-                self._bps_accum = 0
-                handles = []
-                for name, p in sorted(self._grad_names(),
-                                      key=lambda nv: nv[0]):
-                    if p.grad is None:
-                        continue
-                    handles.append((p, push_pull_async_inplace(
-                        p.grad, average=True, name=f"Gradient.{name}",
-                        compression=compression)))
-                for _, h in handles:
-                    synchronize(h)
-                if backward_passes_per_step > 1:
-                    for _, p in self._grad_names():
-                        if p.grad is not None:
-                            with torch.no_grad():
-                                p.grad.div_(backward_passes_per_step)
-                # grads persist after step() like the reference/Horovod —
-                # the user zeroes them (zero_grad here would break loops
-                # that inspect post-step gradient norms)
-                return super().step(closure)
-            return None  # accumulate: skip comm + update like the reference
+            self.synchronize()
+            # grads persist after step() like the reference/Horovod —
+            # the user zeroes them (zero_grad here would break loops
+            # that inspect post-step gradient norms)
+            return super().step(closure)
 
     opt = optimizer
     opt.__class__ = _DistributedOptimizer
+    opt._bps_setup()
     return opt

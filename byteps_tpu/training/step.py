@@ -170,33 +170,55 @@ def lm_loss_fn(model, fused_head: bool = False,
     fused op — the [B, T, vocab] logits never materialize.  The full
     B*T rows go to the kernel (keeping N block-divisible for typical
     sequence lengths); the shift-off last position rides the kernel's
-    ignore-index semantics (target -1 → loss 0, no grad).  Requires a
-    model exposing ``hidden`` and an ``lm_head`` Dense (models/
-    transformer.Transformer does).  ``block_n``/``block_v`` pass through
-    to the kernel for vocab/batch sizes its auto-fit cannot divide
-    (e.g. GPT-2's 50257).
+    ignore-index semantics (out-of-range target → loss 0, no grad).
+    Requires a model exposing ``hidden`` and an ``lm_head`` Dense
+    (models/transformer.Transformer does).  ``block_n``/``block_v`` pass
+    through to the kernel for vocab/batch sizes its auto-fit cannot
+    divide (e.g. GPT-2's 50257).
+
+    Padded streams: pass ``batch["labels"]`` with ``-100`` on ignored
+    positions (the HF convention; ``tokens`` keep an embeddable pad id).
+    The mean is over *valid* targets — ignored positions contribute
+    neither loss nor denominator, in both the fused and plain branches.
     """
 
     def loss_fn(params, model_state, batch):
         tokens = batch["tokens"]
-        targets = jnp.roll(tokens, -1, axis=1)
+        if "labels" in batch:
+            # HF convention: explicit labels with -100 on padded/ignored
+            # positions (tokens themselves must stay embeddable pad ids)
+            targets = jnp.roll(batch["labels"], -1, axis=1)
+        else:
+            targets = jnp.roll(tokens, -1, axis=1)
+        targets = targets.at[:, -1].set(-100)  # ignore the wrap position
         if fused_head:
             from ..ops.fused_cross_entropy import fused_linear_cross_entropy
 
             h = model.apply({"params": params}, tokens, method=model.hidden)
             w = params["lm_head"]["kernel"].astype(h.dtype)
             B, T, d = h.shape
-            targets = targets.at[:, -1].set(-1)  # ignore the wrap position
+            V = w.shape[-1]
+            flat_t = targets.reshape(-1)
             per_row = fused_linear_cross_entropy(
-                h.reshape(-1, d), w, targets.reshape(-1),
-                block_n, block_v,
+                h.reshape(-1, d), w, flat_t, block_n, block_v,
             )
-            loss = per_row.sum() / (B * (T - 1))
+            # mean over *valid* targets only: with padded token streams
+            # (HF -100 convention) a fixed B*(T-1) denominator deflates
+            # the loss; the kernel already zeroes ignored rows
+            valid = jnp.sum((flat_t >= 0) & (flat_t < V))
+            loss = per_row.sum() / jnp.maximum(valid, 1).astype(per_row.dtype)
         else:
             logits = model.apply({"params": params}, tokens)
-            loss = optax.softmax_cross_entropy_with_integer_labels(
-                logits[:, :-1], targets[:, :-1]
-            ).mean()
+            t = targets[:, :-1]
+            valid = (t >= 0) & (t < logits.shape[-1])
+            # optax's integer-label CE has no ignore-index: out-of-range
+            # labels produce garbage — clamp them and zero their loss
+            per_tok = optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], jnp.where(valid, t, 0)
+            )
+            per_tok = jnp.where(valid, per_tok, 0.0)
+            loss = per_tok.sum() / jnp.maximum(valid.sum(), 1).astype(
+                per_tok.dtype)
         return loss, model_state
 
     return loss_fn

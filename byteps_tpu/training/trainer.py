@@ -215,14 +215,30 @@ class Trainer:
 
     def evaluate(self, eval_fn: Callable, batches: Iterable) -> Dict[str, float]:
         """Average ``eval_fn(state, batch) -> {metric: scalar}`` over
-        batches and across workers (reference MetricAverageCallback)."""
+        batches and across workers (reference MetricAverageCallback).
+
+        Host reads ride the same bounded in-flight window as ``fit``:
+        ``float(v)`` on the newest batch would sync the device per batch
+        and serialize dispatch, so summation happens on values from a few
+        batches back while newer eval steps are already in flight.
+        """
         sums: Dict[str, float] = {}
         n = 0
-        for batch in batches:
-            batch = shard_batch(batch, self.mesh)
-            out = eval_fn(self.state, batch)
+        window = 4
+        inflight: list = []
+
+        def drain(out):
+            nonlocal n
             for k, v in out.items():
                 sums[k] = sums.get(k, 0.0) + float(v)
             n += 1
+
+        for batch in batches:
+            batch = shard_batch(batch, self.mesh)
+            inflight.append(eval_fn(self.state, batch))
+            if len(inflight) > window:
+                drain(inflight.pop(0))
+        for out in inflight:
+            drain(out)
         means = {k: v / max(n, 1) for k, v in sums.items()}
         return average_metrics(means)

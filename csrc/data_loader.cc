@@ -63,8 +63,9 @@ struct Loader {
   uint64_t seed = 0;
 
   std::mutex mu;
-  std::condition_variable cv_free, cv_ready;
+  std::condition_variable cv_free, cv_ready, cv_drained;
   std::vector<std::thread> workers;
+  int consumers_in_acquire = 0;  // destroy drains these before freeing
   bool stopping = false;
 
   void reshuffle_locked() {
@@ -164,16 +165,24 @@ void* bps_loader_create(const uint8_t* data, int64_t n_samples,
 
 // Blocks until a batch is ready; returns the slot id and exposes zero-copy
 // pointers into the ring.  The caller MUST bps_loader_release(slot) when
-// done with the views.
+// done with the views.  Returns -1 if the loader is shutting down (a
+// consumer blocked here during bps_loader_destroy must bail out, not
+// deadlock).
 int bps_loader_acquire(void* loader, uint8_t** out_data,
                        int32_t** out_labels) {
   auto* L = static_cast<Loader*>(loader);
   std::unique_lock<std::mutex> lk(L->mu);
-  L->cv_ready.wait(lk, [&] { return !L->ready_q.empty(); });
-  int slot = L->ready_q.front();
-  L->ready_q.pop();
-  *out_data = L->slots[slot].data();
-  *out_labels = L->slot_labels[slot].data();
+  ++L->consumers_in_acquire;
+  L->cv_ready.wait(lk, [&] { return L->stopping || !L->ready_q.empty(); });
+  int slot = -1;
+  if (!L->ready_q.empty()) {
+    slot = L->ready_q.front();
+    L->ready_q.pop();
+    *out_data = L->slots[slot].data();
+    *out_labels = L->slot_labels[slot].data();
+  }  // else: stopping with nothing buffered -> -1, caller bails out
+  if (--L->consumers_in_acquire == 0 && L->stopping)
+    L->cv_drained.notify_all();
   return slot;
 }
 
@@ -199,10 +208,15 @@ int64_t bps_loader_epoch(void* loader) {
 void bps_loader_destroy(void* loader) {
   auto* L = static_cast<Loader*>(loader);
   {
-    std::lock_guard<std::mutex> lk(L->mu);
+    std::unique_lock<std::mutex> lk(L->mu);
     L->stopping = true;
+    L->cv_free.notify_all();
+    L->cv_ready.notify_all();  // wake consumers blocked in acquire
+    // drain: a consumer inside acquire still touches L->mu/ready_q; do
+    // not free state under it (acquire after destroy RETURNS is still a
+    // caller bug, as for any handle ABI)
+    L->cv_drained.wait(lk, [&] { return L->consumers_in_acquire == 0; });
   }
-  L->cv_free.notify_all();
   for (auto& t : L->workers) t.join();
   delete L;
 }

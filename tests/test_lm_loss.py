@@ -83,3 +83,54 @@ def test_flash_composes_with_tensor_parallel():
     out_local = run("local")
     np.testing.assert_allclose(np.asarray(out_flash), np.asarray(out_local),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_padded_labels_normalize_by_valid_count():
+    """HF -100 ignore-index (ADVICE r2): padded positions contribute
+    neither loss nor denominator, in both branches, and both branches
+    agree; the non-fused branch must not feed -100 into optax."""
+    model = Transformer(_tiny_cfg())
+    B, T = 4, 16
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (B, T), 1, 64)
+    labels = np.asarray(tokens).copy()
+    labels[:, T // 2:] = -100          # second half padded
+    tokens_padded = np.asarray(tokens).copy()
+    tokens_padded[:, T // 2:] = 0      # embeddable pad id
+    batch = {"tokens": jnp.asarray(tokens_padded),
+             "labels": jnp.asarray(labels)}
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((B, T), jnp.int32))["params"]
+
+    naive = lm_loss_fn(model, fused_head=False)
+    fused = lm_loss_fn(model, fused_head=True)
+    l_n, _ = naive(params, {}, batch)
+    l_f, _ = fused(params, {}, batch)
+    assert np.isfinite(float(l_n)) and np.isfinite(float(l_f))
+    np.testing.assert_allclose(float(l_f), float(l_n), rtol=1e-5)
+
+    # hand-computed reference: mean CE over the valid (first-half) shifts
+    logits = model.apply({"params": params}, batch["tokens"])
+    tgt = np.roll(labels, -1, axis=1)
+    tgt[:, -1] = -100
+    per = optax.softmax_cross_entropy_with_integer_labels(
+        logits[:, :-1], jnp.asarray(np.where(tgt[:, :-1] < 0, 0,
+                                             tgt[:, :-1])))
+    mask = tgt[:, :-1] >= 0
+    want = float((np.asarray(per) * mask).sum() / mask.sum())
+    np.testing.assert_allclose(float(l_n), want, rtol=1e-5)
+
+
+def test_fully_valid_stream_unchanged_vs_mean():
+    """No padding -> the valid-count mean equals the old fixed-denominator
+    mean (back-compat for the perplexity example)."""
+    model = Transformer(_tiny_cfg())
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0, 64)
+    batch = {"tokens": tokens}
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((2, 16), jnp.int32))["params"]
+    l, _ = lm_loss_fn(model, fused_head=False)(params, {}, batch)
+    logits = model.apply({"params": params}, tokens)
+    targets = jnp.roll(tokens, -1, axis=1)
+    want = optax.softmax_cross_entropy_with_integer_labels(
+        logits[:, :-1], targets[:, :-1]).mean()
+    np.testing.assert_allclose(float(l), float(want), rtol=1e-6)

@@ -105,8 +105,9 @@ def test_distributed_optimizer_trains():
 
 
 def test_distributed_optimizer_backward_passes_per_step():
-    """k-1 accumulation steps perform no update; the k-th applies the
-    k-averaged gradient (reference backward_passes_per_step semantics)."""
+    """Reference contract (torch/__init__.py:140-154): hooks count
+    *backward passes*; N backwards then ONE step() applies the summed
+    accumulated gradient (no division — Horovod semantics)."""
     model = torch.nn.Linear(2, 1, bias=False)
     with torch.no_grad():
         model.weight.fill_(1.0)
@@ -117,11 +118,104 @@ def test_distributed_optimizer_backward_passes_per_step():
     )
     x = torch.ones(1, 2)
 
-    model(x).sum().backward()      # grad = [1, 1]
-    opt.step()                     # accumulate only
+    model(x).sum().backward()      # grad = [1, 1]; delay 2 -> 1, no comm
+    assert opt._bps_handles[model.weight] is None
+    model(x).sum().backward()      # grad accumulates to [2, 2]; enqueues
+    assert opt._bps_handles[model.weight] is not None
+    opt.step()                     # update with the accumulated [2, 2]
     torch.testing.assert_close(model.weight,
-                               torch.ones_like(model.weight))
-    model(x).sum().backward()      # grad accumulates to [2, 2]
-    opt.step()                     # update with [2,2]/2 = [1,1]
+                               -torch.ones_like(model.weight))
+
+
+def test_distributed_optimizer_excess_backward_raises():
+    """A third backward before step() with backward_passes_per_step=2
+    raises (reference torch/__init__.py:141-147 assertion) — deferred to
+    synchronize/step: raising inside an autograd hook can terminate the
+    process, so the hook records the violation instead."""
+    model = torch.nn.Linear(2, 1, bias=False)
+    opt = bps_t.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=1.0),
+        named_parameters=model.named_parameters(),
+        backward_passes_per_step=2,
+    )
+    x = torch.ones(1, 2)
+    model(x).sum().backward()
+    model(x).sum().backward()
+    model(x).sum().backward()  # one too many — recorded, not raised here
+    with pytest.raises(AssertionError, match="backward_passes_per_step"):
+        opt.step()
+
+
+def test_distributed_optimizer_early_step_reduces_accumulated():
+    """step() before the Nth backward still reduces + applies whatever has
+    accumulated (reference synchronize covers missing/None handles)."""
+    model = torch.nn.Linear(2, 1, bias=False)
+    with torch.no_grad():
+        model.weight.fill_(1.0)
+    opt = bps_t.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=1.0),
+        named_parameters=model.named_parameters(),
+        backward_passes_per_step=4,
+    )
+    x = torch.ones(1, 2)
+    model(x).sum().backward()      # 1 of 4 passes
+    opt.step()                     # applies [1, 1]
     torch.testing.assert_close(model.weight,
                                torch.zeros_like(model.weight))
+    # delays re-armed: the next 4-pass cycle starts fresh
+    assert all(d == 4 for d in opt._bps_delay.values())
+
+
+def test_hooks_enqueue_during_backward_in_priority_order():
+    """The hook protocol (reference torch/__init__.py:112-154): push_pull
+    tasks enter the engine *during* loss.backward() — before step() — in
+    backward order (last layer first), each carrying the reference
+    priority (-declared key, so earlier-declared names drain first)."""
+    from byteps_tpu.engine import dispatcher as _dispatcher
+
+    model = torch.nn.Sequential(
+        torch.nn.Linear(4, 8, bias=False),
+        torch.nn.ReLU(),
+        torch.nn.Linear(8, 1, bias=False),
+    )
+    engine = _dispatcher.get_engine()
+    seen = []
+    orig = engine.push_pull_async
+
+    def spy(stacked, name, **kw):
+        seen.append(name)
+        return orig(stacked, name, **kw)
+
+    engine.push_pull_async = spy
+    try:
+        opt = bps_t.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters(),
+        )
+        loss = model(torch.randn(4, 4)).sum()
+        loss.backward()
+        # comm was enqueued by the hooks, before any step()/synchronize()
+        assert seen == ["Gradient.2.weight", "Gradient.0.weight"]
+        assert all(h is not None for h in opt._bps_handles.values())
+        opt.step()
+    finally:
+        engine.push_pull_async = orig
+    # correctness: single worker, averaged grad == local grad -> plain SGD
+    for p in model.parameters():
+        assert p.grad is not None
+
+
+def test_distributed_optimizer_synchronize_for_clipping():
+    """Public synchronize() between backward and step() (the reference's
+    gradient-clipping recipe, torch/__init__.py docstring)."""
+    model = torch.nn.Linear(4, 1, bias=False)
+    opt = bps_t.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.1),
+        named_parameters=model.named_parameters(),
+    )
+    (model(torch.ones(2, 4)).sum() * 100).backward()
+    opt.synchronize()
+    torch.nn.utils.clip_grad_norm_(model.parameters(), 1.0)
+    g = model.weight.grad.clone()
+    assert float(g.norm()) <= 1.0 + 1e-5
+    opt.step()
