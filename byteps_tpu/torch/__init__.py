@@ -366,9 +366,15 @@ def DistributedOptimizer(optimizer, named_parameters: Optional[
                 synchronize(h)  # module-level: writes back into p.grad
                 self._bps_delay[p] = self._bps_passes
             self._bps_handles.clear()
+            self._bps_synchronized = True
 
         def step(self, closure=None):
-            self.synchronize()
+            # an explicit user synchronize() (the gradient-clipping
+            # recipe) already reduced this step's gradients — do not
+            # reduce them a second time (Horovod's _synchronized guard)
+            if not getattr(self, "_bps_synchronized", False):
+                self.synchronize()
+            self._bps_synchronized = False
             # grads persist after step() like the reference/Horovod —
             # the user zeroes them (zero_grad here would break loops
             # that inspect post-step gradient norms)

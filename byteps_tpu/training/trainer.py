@@ -35,6 +35,13 @@ from .step import (
 )
 
 
+# Bounded dispatch pipelining depth shared by fit/evaluate: unbounded async
+# dispatch of data-dependent steps can starve XLA's collective rendezvous
+# (the virtual-CPU harness SIGABRTs); blocking on results from this many
+# iterations back keeps the pipeline full while bounding it.
+_INFLIGHT_WINDOW = 4
+
+
 class Trainer:
     def __init__(
         self,
@@ -159,11 +166,7 @@ class Trainer:
         # iteration would force a device sync per step and serialize the
         # async dispatch pipeline whose overlap is the performance story.
         start_step = int(state.step)
-        # Bounded in-flight window: unbounded async dispatch of
-        # data-dependent steps can starve XLA's collective rendezvous (the
-        # virtual-CPU harness SIGABRTs); blocking on the state from a few
-        # steps back keeps ≤window steps in flight while preserving overlap.
-        window = 4
+        window = _INFLIGHT_WINDOW
         inflight: list = []
         for i, batch in enumerate(batches):
             if steps is not None and i >= steps:
@@ -224,7 +227,7 @@ class Trainer:
         """
         sums: Dict[str, float] = {}
         n = 0
-        window = 4
+        window = _INFLIGHT_WINDOW
         inflight: list = []
 
         def drain(out):

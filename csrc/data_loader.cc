@@ -175,12 +175,14 @@ int bps_loader_acquire(void* loader, uint8_t** out_data,
   ++L->consumers_in_acquire;
   L->cv_ready.wait(lk, [&] { return L->stopping || !L->ready_q.empty(); });
   int slot = -1;
-  if (!L->ready_q.empty()) {
+  if (!L->stopping && !L->ready_q.empty()) {
+    // never hand out a slot once stopping: destroy frees the ring as soon
+    // as consumers drain, so returned pointers would dangle
     slot = L->ready_q.front();
     L->ready_q.pop();
     *out_data = L->slots[slot].data();
     *out_labels = L->slot_labels[slot].data();
-  }  // else: stopping with nothing buffered -> -1, caller bails out
+  }
   if (--L->consumers_in_acquire == 0 && L->stopping)
     L->cv_drained.notify_all();
   return slot;

@@ -12,7 +12,8 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
-sys.path.insert(0, "/root/repo")
+import os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from byteps_tpu.common.timing import readback_barrier
 from byteps_tpu.models import ResNet50
 from byteps_tpu.training import classification_loss_fn, make_data_parallel_step, shard_batch
