@@ -1,0 +1,69 @@
+"""Keras front-end — the byteps_tpu rendering of the reference's
+``byteps.keras`` plugin (keras/__init__.py:31-123): DistributedOptimizer,
+value-level push_pull/broadcast, and ``load_model`` that re-wraps the
+deserialized optimizer; the callback set lives in
+``byteps_tpu.keras.callbacks``.
+
+Targets Keras 3 (the installed generation); the reference's TF1/keras-2
+session plumbing (``K.get_session()``) has no analog here — everything is
+eager or ``tf.py_function``-bridged (see byteps_tpu.tensorflow).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .. import tensorflow as _bps_tf
+from ..ops.compression import Compression
+from . import callbacks  # noqa: F401  (public submodule, like the reference)
+
+__all__ = [
+    "init", "shutdown", "rank", "size", "local_rank", "local_size",
+    "push_pull", "broadcast", "broadcast_variables",
+    "DistributedOptimizer", "load_model", "callbacks", "Compression",
+]
+
+init = _bps_tf.init
+shutdown = _bps_tf.shutdown
+rank = _bps_tf.rank
+size = _bps_tf.size
+local_rank = _bps_tf.local_rank
+local_size = _bps_tf.local_size
+broadcast_variables = _bps_tf.broadcast_variables
+DistributedOptimizer = _bps_tf.DistributedOptimizer
+
+
+def push_pull(value, name: Optional[str] = None, average: bool = True):
+    """Average a value (tensor or numpy/scalar) across workers (reference
+    keras/__init__.py:69-79)."""
+    return np.asarray(_bps_tf.push_pull(value, average=average, name=name))
+
+
+def broadcast(value, root_rank: int = 0, name: Optional[str] = None):
+    """Every worker receives ``root_rank``'s value (reference
+    keras/__init__.py:82-92)."""
+    return np.asarray(_bps_tf.broadcast(value, root_rank=root_rank,
+                                        name=name))
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compression: type = Compression.none):
+    """Load a saved keras model with its optimizer wrapped as a
+    ``DistributedOptimizer`` (reference keras/__init__.py:95-123).
+
+    The reference injects wrapped optimizer classes into
+    ``custom_objects`` during deserialization; Keras 3 deserializes
+    cleanly, so the optimizer instance is re-wrapped in place after
+    loading — same result (``custom_optimizers`` accepted for parity:
+    extra classes to expose during deserialization)."""
+    import keras
+
+    objs = dict(custom_objects or {})
+    for cls in custom_optimizers or ():
+        objs.setdefault(cls.__name__, cls)
+    model = keras.models.load_model(filepath, custom_objects=objs or None)
+    if getattr(model, "optimizer", None) is not None:
+        DistributedOptimizer(model.optimizer, compression=compression)
+    return model

@@ -1,0 +1,150 @@
+"""Keras callbacks — the reference's ``byteps.keras.callbacks``
+(keras/callbacks.py:23-160, horovod-derived _impl semantics) for Keras 3:
+
+  * BroadcastGlobalVariablesCallback — consistent init: broadcast model +
+    optimizer variables from root once training starts (variables only
+    exist after the first batch builds them);
+  * MetricAverageCallback — average epoch metrics across workers before
+    other callbacks (checkpointing, early stopping) read them;
+  * LearningRateScheduleCallback / LearningRateWarmupCallback — the
+    multiply-the-base-lr schedule pair, incl. the gradual warmup ramp from
+    lr to lr*size over the first epochs (Goyal et al., the recipe the
+    reference's examples use).
+
+This module imports keras (it is the keras integration); the core
+framework does not.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import keras
+import numpy as np
+
+from .. import tensorflow as _bps_tf
+
+
+class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
+    """Broadcast all model + optimizer variables from ``root_rank`` at the
+    start of training (reference keras/callbacks.py:23-40).  Runs after
+    the first batch so lazily-built variables exist."""
+
+    def __init__(self, root_rank: int = 0, device: str = ""):
+        super().__init__()
+        del device  # parity arg (reference pins a GPU; the mesh decides)
+        self.root_rank = root_rank
+        self.broadcast_done = False
+
+    def on_train_batch_end(self, batch, logs=None):
+        if self.broadcast_done:
+            return
+        variables = list(self.model.variables)
+        opt = getattr(self.model, "optimizer", None)
+        if opt is not None:
+            variables += list(opt.variables)
+        _bps_tf.broadcast_variables(variables, root_rank=self.root_rank)
+        self.broadcast_done = True
+
+
+class MetricAverageCallback(keras.callbacks.Callback):
+    """Average epoch-end metrics across workers (reference
+    keras/callbacks.py:43-60) so checkpoint/early-stop callbacks see the
+    global value on every worker."""
+
+    def on_epoch_end(self, epoch, logs=None):
+        if not logs:
+            return
+        keys = sorted(k for k, v in logs.items()
+                      if isinstance(v, (int, float, np.floating)))
+        if not keys:
+            return
+        vec = np.asarray([float(logs[k]) for k in keys], np.float64)
+        avg = np.asarray(_bps_tf.push_pull(
+            vec, average=True, name="MetricAverageCallback"))
+        for k, v in zip(keys, avg):
+            logs[k] = float(v)
+
+
+class LearningRateScheduleCallback(keras.callbacks.Callback):
+    """Multiply the optimizer's base lr by ``multiplier(epoch)`` within
+    [start_epoch, end_epoch) (reference keras/callbacks.py:63-97);
+    ``staircase=False`` with ``steps_per_epoch`` interpolates per batch."""
+
+    def __init__(self, multiplier, start_epoch: int = 0,
+                 end_epoch: Optional[int] = None, staircase: bool = True,
+                 momentum_correction: bool = True, steps_per_epoch=None):
+        super().__init__()
+        del momentum_correction  # parity arg; keras 3 has no raw-momentum
+        self.multiplier = (multiplier if callable(multiplier)
+                           else (lambda epoch: multiplier))
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.steps_per_epoch = steps_per_epoch
+        self.base_lr: Optional[float] = None
+        self.current_epoch = 0
+
+    def _in_range(self, epoch) -> bool:
+        if epoch < self.start_epoch:
+            return False
+        return self.end_epoch is None or epoch < self.end_epoch
+
+    def _apply(self, epoch) -> None:
+        if self.base_lr is None or not self._in_range(epoch):
+            return
+        self.model.optimizer.learning_rate = self.base_lr * float(
+            self.multiplier(epoch))
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+        if self.base_lr is None:
+            self.base_lr = float(
+                np.asarray(self.model.optimizer.learning_rate))
+        # staircase: the epoch value IS the schedule; smooth without
+        # steps_per_epoch: epoch granularity is the best we can do (a
+        # smooth schedule must not silently no-op)
+        if self.staircase or not self.steps_per_epoch:
+            self._apply(epoch)
+
+    def on_train_batch_begin(self, batch, logs=None):
+        if self.staircase or not self.steps_per_epoch:
+            return
+        self._apply(self.current_epoch + batch / self.steps_per_epoch)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is not None:
+            logs["lr"] = float(
+                np.asarray(self.model.optimizer.learning_rate))
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Gradual per-batch warmup from lr to lr*size() over
+    ``warmup_epochs`` (reference keras/callbacks.py:100-160): with k
+    workers the effective batch is k times larger, so the target rate is
+    k times the base — ramped, not stepped, to keep early training
+    stable."""
+
+    def __init__(self, warmup_epochs: int = 5,
+                 momentum_correction: bool = True, steps_per_epoch=None,
+                 verbose: int = 0):
+        size = _bps_tf.size()
+        self.warmup_epochs = warmup_epochs
+        self.verbose = verbose
+
+        def multiplier(epoch):
+            if warmup_epochs <= 0:
+                return size
+            frac = min(float(epoch) / warmup_epochs, 1.0)
+            return 1.0 + frac * (size - 1)
+
+        super().__init__(multiplier=multiplier, start_epoch=0,
+                         end_epoch=warmup_epochs + 1, staircase=False,
+                         momentum_correction=momentum_correction,
+                         steps_per_epoch=steps_per_epoch)
+
+    def on_epoch_end(self, epoch, logs=None):
+        super().on_epoch_end(epoch, logs)
+        if self.verbose and epoch < self.warmup_epochs:
+            lr = float(np.asarray(self.model.optimizer.learning_rate))
+            print(f"Epoch {epoch + 1}: warmup lr = {lr:.6g}")

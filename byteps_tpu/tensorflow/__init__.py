@@ -1,0 +1,278 @@
+"""TensorFlow front-end — the byteps_tpu rendering of the reference's
+``byteps.tensorflow`` plugin (tensorflow/__init__.py:33-307, ops.py:96-218):
+the same Horovod-compatible surface for **TF2-eager training programs whose
+collectives ride the TPU mesh**.
+
+Mapping: one TF process == one worker (the reference maps one process per
+GPU).  Tensors convert tf↔numpy at the boundary; the reduction itself runs
+as the engine's scheduled SPMD program (api.push_pull_async_process),
+across processes via the multihost path when launched through
+``bpslaunch``/`jax.distributed`.
+
+Renderings of the reference's TF1-era pieces, by design:
+  * ``DistributedOptimizer`` wraps a Keras-3 optimizer (``apply``/
+    ``apply_gradients`` reduce first) instead of ``tf.train.Optimizer``
+    (sessions are gone in TF2; the reference's own eager path is
+    ``DistributedGradientTape``, tensorflow/__init__.py:285-307);
+  * ``BroadcastGlobalVariablesHook`` (a ``tf.train.SessionRunHook``,
+    tensorflow/__init__.py:86-116) has no session to hook — its role is
+    served by ``broadcast_variables`` and the keras callback
+    (byteps_tpu.keras.callbacks.BroadcastGlobalVariablesCallback);
+  * ``device_dense``/``device_sparse`` args are accepted and ignored
+    (device placement belongs to the mesh, not per-op hints).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Iterable, Optional
+
+import numpy as np
+
+from .. import api as _api
+from ..ops.compression import Compression
+
+__all__ = [
+    "init", "shutdown", "rank", "size", "local_rank", "local_size",
+    "declare", "push_pull", "push_pull_async", "poll", "synchronize",
+    "broadcast", "broadcast_variables", "DistributedGradientTape",
+    "DistributedOptimizer", "Compression",
+]
+
+init = _api.init
+shutdown = _api.shutdown
+rank = _api.rank
+local_rank = _api.local_rank
+local_size = _api.local_size
+declare = _api.declare
+
+
+def size() -> int:
+    """One worker == one TF process (reference byteps.tensorflow maps one
+    process per GPU) — NOT the mesh device count ``api.size()``."""
+    import jax
+
+    return jax.process_count()
+
+
+def _tf():
+    import tensorflow as tf  # local import: the framework must not require TF
+
+    return tf
+
+
+def _to_np(t) -> np.ndarray:
+    tf = _tf()
+    if isinstance(t, tf.IndexedSlices):
+        t = tf.convert_to_tensor(t)  # sparse_as_dense (reference
+        # tensorflow/__init__.py:141-149 converts before reducing)
+    if hasattr(t, "numpy"):
+        return t.numpy()
+    return np.asarray(t)
+
+
+# handle -> template tf tensor/dtype for result conversion
+_handles: Dict[int, Any] = {}
+_handles_lock = threading.Lock()
+
+
+def push_pull_async(tensor, average: bool = True, name: Optional[str] = None,
+                    version: int = 0, priority: int = 0,
+                    compression: type = Compression.none) -> int:
+    """Async push_pull of a tf tensor; returns a handle
+    (reference ops.py:96-161)."""
+    handle = _api.push_pull_async_process(
+        _to_np(tensor), average=average, name=name, version=version,
+        priority=priority, compression=compression,
+    )
+    with _handles_lock:
+        _handles[handle] = tensor
+    return handle
+
+
+def poll(handle: int) -> bool:
+    return _api.poll(handle)
+
+
+def synchronize(handle: int):
+    """Block until the handle completes; returns a tf.Tensor
+    (reference ops.py:204-218)."""
+    tf = _tf()
+    out = np.asarray(_api.synchronize(handle))
+    with _handles_lock:
+        template = _handles.pop(handle, None)
+    if template is None:
+        return tf.constant(out)
+    t = tf.convert_to_tensor(out)
+    if hasattr(template, "dtype"):
+        t = tf.cast(t, template.dtype)
+    if hasattr(template, "shape") and template.shape is not None:
+        t = tf.reshape(t, template.shape)
+    return t
+
+
+def push_pull(tensor, scope: str = "", average: bool = True,
+              name: Optional[str] = None,
+              device_dense: str = "", device_sparse: str = "",
+              compression: type = Compression.none):
+    """Sum/average a tf tensor across workers (reference
+    tensorflow/__init__.py:33-61 contract; scope/device args accepted for
+    parity, unused under the mesh)."""
+    del scope, device_dense, device_sparse
+    return synchronize(push_pull_async(
+        tensor, average=average, name=name, compression=compression))
+
+
+def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
+    """Every worker receives ``root_rank``'s value (reference ops.py:163-196)."""
+    tf = _tf()
+    arr = _to_np(tensor)
+    if _api.jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        arr = np.asarray(multihost_utils.broadcast_one_to_all(
+            arr, is_source=_api.jax.process_index() == root_rank))
+    out = tf.convert_to_tensor(arr)
+    if hasattr(tensor, "dtype"):
+        out = tf.cast(out, tensor.dtype)
+    return out
+
+
+def broadcast_variables(variables: Iterable, root_rank: int = 0) -> None:
+    """In-place broadcast of tf.Variables from ``root_rank`` (reference
+    tensorflow/__init__.py:74-83).  One pytree == one process-level
+    collective for the whole list."""
+    vs = list(variables)
+    tree = {f"Parameter.{i}.{getattr(v, 'name', '')}": _to_np(v)
+            for i, v in enumerate(vs)}
+    out = _api.broadcast_parameters(tree, root_rank=root_rank)
+    for i, v in enumerate(vs):
+        dt = v.dtype  # tf.DType, or a plain string on keras-3 Variables
+        np_dt = np.dtype(getattr(dt, "as_numpy_dtype", None) or dt)
+        v.assign(np.asarray(out[f"Parameter.{i}.{getattr(v, 'name', '')}"])
+                 .astype(np_dt).reshape(tuple(v.shape)))
+
+
+def broadcast_global_variables(root_rank: int = 0, scope: str = "") -> None:
+    """TF1 compatibility name (reference tensorflow/__init__.py:64-71).
+    TF2 has no global-variables collection; raise with the TF2 recipe."""
+    raise NotImplementedError(
+        "TF2 has no global variables collection; call "
+        "broadcast_variables(model.variables + optimizer.variables, "
+        f"root_rank={root_rank}) after the first step, or use "
+        "byteps_tpu.keras.callbacks.BroadcastGlobalVariablesCallback")
+
+
+def _grad_name(i: int, var) -> str:
+    name = getattr(var, "path", None) or getattr(var, "name", None) or str(i)
+    return f"Gradient.{name}"
+
+
+def _reduce_grads(grads, variables, compression) -> list:
+    """Reduce a gradient list across workers, None-preserving, issue order
+    deterministic (enumeration order == variable order on every process —
+    the reference's declared-tensor contract).
+
+    Works both eagerly and inside a ``tf.function`` graph (keras
+    ``model.fit``): in graph mode the reduction rides ``tf.py_function``,
+    which executes the engine calls eagerly at runtime.  XLA-jitted
+    functions cannot host py_function — compile with ``jit_compile=False``
+    (or ``run_eagerly=True``)."""
+    tf = _tf()
+    idx = [i for i, g in enumerate(grads) if g is not None]
+    if not idx:
+        return list(grads)
+    names = [_grad_name(i, variables[i]) for i in idx]
+    live = [grads[i] for i in idx]
+    live = [tf.convert_to_tensor(g) if isinstance(g, tf.IndexedSlices)
+            else g for g in live]
+
+    def _do(*gs):
+        handles = [push_pull_async(g, average=True, name=n,
+                                   compression=compression)
+                   for g, n in zip(gs, names)]
+        return [synchronize(h) for h in handles]
+
+    if tf.executing_eagerly():
+        reduced = _do(*live)
+    else:
+        reduced = tf.py_function(_do, live, [g.dtype for g in live])
+        if not isinstance(reduced, (list, tuple)):
+            reduced = [reduced]
+        for r, g in zip(reduced, live):
+            r.set_shape(g.shape)
+    out = list(grads)
+    for i, r in zip(idx, reduced):
+        g = grads[i]
+        out[i] = tf.cast(r, g.dtype) if hasattr(g, "dtype") else r
+    return out
+
+
+def DistributedGradientTape(gradtape, device_dense: str = "",
+                            device_sparse: str = "",
+                            compression: type = Compression.none,
+                            sparse_as_dense: bool = True):
+    """Wrap a ``tf.GradientTape`` so ``gradient()`` averages the results
+    across workers (reference tensorflow/__init__.py:285-307)."""
+    del device_dense, device_sparse
+    if not sparse_as_dense:
+        raise ValueError("sparse gradients ride the dense path on the mesh; "
+                         "sparse_as_dense=False is not supported")
+
+    base = gradtape.__class__
+
+    class _DistributedGradientTape(base):
+        def gradient(self, target, sources, output_gradients=None):
+            grads = super().gradient(target, sources, output_gradients)
+            one = not isinstance(grads, (list, tuple))
+            glist = [grads] if one else list(grads)
+            slist = [sources] if one else list(sources)
+            reduced = _reduce_grads(glist, slist, compression)
+            return reduced[0] if one else reduced
+
+    gradtape.__class__ = _DistributedGradientTape
+    return gradtape
+
+
+def DistributedOptimizer(optimizer, name: Optional[str] = None,
+                         use_locking: bool = False, device_dense: str = "",
+                         device_sparse: str = "",
+                         compression: type = Compression.none,
+                         sparse_as_dense: bool = True):
+    """Wrap a Keras-3 optimizer so gradients are push_pulled (averaged)
+    across workers before it applies them — the reference's
+    ``DistributedOptimizer`` (tensorflow/__init__.py:118-228) re-expressed
+    for the TF2/Keras-3 optimizer API (``apply``/``apply_gradients``).
+
+    ``name``/``use_locking``/device args accepted for parity; sparse
+    gradients (IndexedSlices) are densified before reducing, the
+    reference's ``sparse_as_dense`` path."""
+    del name, use_locking, device_dense, device_sparse
+    if not sparse_as_dense:
+        raise ValueError("sparse gradients ride the dense path on the mesh; "
+                         "sparse_as_dense=False is not supported")
+
+    base = optimizer.__class__
+
+    # Keras 3's apply_gradients delegates to apply, so overriding apply
+    # alone covers both entry points exactly once.
+    def _apply(self, grads, trainable_variables=None):
+        grads = list(grads)
+        varlist = (list(trainable_variables)
+                   if trainable_variables is not None
+                   else list(getattr(self, "_trainable_variables", []))
+                   or list(range(len(grads))))
+        reduced = _reduce_grads(grads, varlist, compression)
+        if trainable_variables is None:
+            return base.apply(self, reduced)
+        return base.apply(self, reduced, trainable_variables)
+
+    # The dynamic subclass keeps the base's name/module (the reference's
+    # own factory trick, torch/__init__.py:226-231): keras serialization
+    # records the *base* class, so a model saved after wrapping loads as
+    # the plain optimizer — byteps_tpu.keras.load_model then re-wraps it.
+    wrapped = type(base.__name__, (base,),
+                   {"apply": _apply, "__module__": base.__module__,
+                    "_bps_distributed": True})
+    optimizer.__class__ = wrapped
+    return optimizer

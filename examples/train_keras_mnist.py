@@ -1,0 +1,71 @@
+"""Keras front-end example — the byteps_tpu rendering of the reference's
+keras workflow (reference example/keras/keras_mnist_advanced.py style):
+wrap the optimizer, add the broadcast/metric/warmup callbacks, fit.
+
+Single process it degenerates to local training (push_pull is the
+identity); launch 2+ processes via bpslaunch for the cross-process path.
+
+    python examples/train_keras_mnist.py --epochs 3
+"""
+
+import argparse
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--lr", type=float, default=0.01)
+    ap.add_argument("--samples", type=int, default=4096,
+                    help="synthetic sample count (no dataset download)")
+    args = ap.parse_args()
+
+    import keras
+
+    import byteps_tpu.keras as bps
+    from byteps_tpu.keras.callbacks import (
+        BroadcastGlobalVariablesCallback,
+        LearningRateWarmupCallback,
+        MetricAverageCallback,
+    )
+
+    bps.init()
+
+    # synthetic MNIST-shaped data (zero-egress image; swap in
+    # keras.datasets.mnist.load_data() where downloads work)
+    rng = np.random.RandomState(bps.rank())
+    x = rng.rand(args.samples, 28, 28, 1).astype(np.float32)
+    y = rng.randint(0, 10, size=(args.samples,))
+
+    model = keras.Sequential([
+        keras.layers.Conv2D(16, 3, activation="relu",
+                            input_shape=(28, 28, 1)),
+        keras.layers.MaxPooling2D(),
+        keras.layers.Flatten(),
+        keras.layers.Dense(64, activation="relu"),
+        keras.layers.Dense(10, activation="softmax"),
+    ])
+    # pass the UNSCALED lr: the warmup callback ramps it to lr*size()
+    opt = bps.DistributedOptimizer(keras.optimizers.SGD(args.lr))
+    model.compile(optimizer=opt, loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"], jit_compile=False)
+
+    steps_per_epoch = max(1, len(x) // args.batch_size)
+    model.fit(
+        x, y, batch_size=args.batch_size, epochs=args.epochs,
+        verbose=2 if bps.rank() == 0 else 0,
+        callbacks=[
+            BroadcastGlobalVariablesCallback(0),
+            MetricAverageCallback(),
+            LearningRateWarmupCallback(warmup_epochs=1,
+                                       steps_per_epoch=steps_per_epoch),
+        ],
+    )
+    if bps.rank() == 0:
+        print("done")
+
+
+if __name__ == "__main__":
+    main()

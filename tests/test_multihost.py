@@ -103,6 +103,51 @@ _TORCH_WORKER = textwrap.dedent(
 )
 
 
+_TF_WORKER = textwrap.dedent(
+    """
+    import numpy as np
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import tensorflow as tf
+    import keras
+    import byteps_tpu.tensorflow as bps
+
+    bps.init()
+    r = bps.rank()
+    assert bps.size() == 2, bps.size()
+
+    # cross-process sum of tf tensors: r+1 each => 3
+    out = bps.push_pull(tf.fill([4], float(r + 1)), average=False,
+                        name="tfsum")
+    assert isinstance(out, tf.Tensor), type(out)
+    np.testing.assert_allclose(out.numpy(), 3.0)
+
+    # DistributedGradientTape: per-worker grads 2*r+2 average to 3
+    w = tf.Variable([1.0, 1.0])
+    with bps.DistributedGradientTape(tf.GradientTape()) as tape:
+        loss = tf.reduce_sum(w * float(r + 1)) * 2.0
+    (g,) = tape.gradient(loss, [w])
+    np.testing.assert_allclose(np.asarray(g), 3.0)
+
+    # broadcast_variables: non-root adopts root's values
+    v = tf.Variable([float(r), float(r)])
+    bps.broadcast_variables([v], root_rank=0)
+    np.testing.assert_allclose(v.numpy(), 0.0)
+
+    # keras optimizer: averaged grad applied identically on both workers
+    opt = bps.DistributedOptimizer(keras.optimizers.SGD(0.5))
+    var = tf.Variable([2.0, 2.0])
+    opt.apply_gradients([(tf.fill([2], float(r + 1)), var)])  # avg grad 1.5
+    np.testing.assert_allclose(var.numpy(), 1.25)
+
+    print(f"TF_WORKER_{r}_OK")
+    bps.shutdown()
+    """
+)
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -161,3 +206,12 @@ def test_two_process_torch_frontend(tmp_path):
     for push_pull (sum/avg/in-place) and broadcast_parameters."""
     pytest.importorskip("torch")
     _run_two_workers(tmp_path, _TORCH_WORKER, "TORCH_WORKER_{wid}_OK")
+
+
+def test_two_process_tf_frontend(tmp_path):
+    """byteps_tpu.tensorflow across 2 real processes: push_pull on tf
+    tensors, DistributedGradientTape averaging, broadcast_variables, and
+    a keras DistributedOptimizer applying the worker-averaged gradient."""
+    pytest.importorskip("tensorflow")
+    pytest.importorskip("keras")
+    _run_two_workers(tmp_path, _TF_WORKER, "TF_WORKER_{wid}_OK")
