@@ -1,0 +1,3 @@
+"""Drop-in integrations for third-party model libraries."""
+
+from .hf_flash import flash_attention_for_hf_bert  # noqa: F401

@@ -1,0 +1,68 @@
+"""Route HuggingFace Flax BERT attention through the Pallas flash kernel.
+
+The reference's pitch is wrapping *stock* framework models
+(example/pytorch/benchmark_byteps.py uses torchvision/HF models as-is);
+the TPU rendering of that pitch for the hot op: swap
+``FlaxBertSelfAttention``'s O(T²) ``dot_product_attention_weights`` path
+for ``ops/flash_attention.py``, keeping the module's own projections and
+parameters — a stock HF checkpoint trains through the flash kernel with
+no weight surgery.
+
+The HF padding ``attention_mask`` rides the kernel's segment ids (pads
+only see pads; valid positions match the masked softmax exactly — see
+flash_attention's docstring).  Configurations the kernel does not cover
+(causal decoder cache, cross-attention, head masking, attention-prob
+dropout, ``output_attentions``) fall back to the stock implementation.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+
+@contextlib.contextmanager
+def flash_attention_for_hf_bert(block_q: int = 512, block_k: int = 1024,
+                                interpret=None):
+    """Context manager: inside it, every HF Flax BERT self-attention
+    (and derived models sharing the class) computes through the flash
+    kernel.  Usage::
+
+        with flash_attention_for_hf_bert():
+            logits = model(tokens, attention_mask=mask, params=params).logits
+    """
+    from transformers.models.bert import modeling_flax_bert as m
+
+    from ..ops.flash_attention import flash_attention
+
+    orig = m.FlaxBertSelfAttention.__call__
+
+    def patched(self, hidden_states, attention_mask,
+                layer_head_mask, key_value_states=None, init_cache=False,
+                deterministic=True, output_attentions=False):
+        uncovered = (
+            output_attentions
+            or layer_head_mask is not None
+            or key_value_states is not None
+            or getattr(self, "causal", False)
+            or init_cache
+            or (not deterministic
+                and self.config.attention_probs_dropout_prob > 0.0)
+        )
+        if uncovered:
+            return orig(self, hidden_states, attention_mask,
+                        layer_head_mask, key_value_states=key_value_states,
+                        init_cache=init_cache, deterministic=deterministic,
+                        output_attentions=output_attentions)
+        q = self._split_heads(self.query(hidden_states))  # [B, T, H, D]
+        k = self._split_heads(self.key(hidden_states))
+        v = self._split_heads(self.value(hidden_states))
+        seg = attention_mask if attention_mask is not None else None
+        out = flash_attention(q, k, v, False, None, block_q, block_k,
+                              interpret, seg)
+        return (self._merge_heads(out.astype(hidden_states.dtype)),)
+
+    m.FlaxBertSelfAttention.__call__ = patched
+    try:
+        yield
+    finally:
+        m.FlaxBertSelfAttention.__call__ = orig

