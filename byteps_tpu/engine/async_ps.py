@@ -249,8 +249,17 @@ class AsyncWorker:
         # snapshot of the state this worker last pulled: deltas are vs this
         self._snapshot = [np.array(np.asarray(l), copy=True) for l in leaves]
         self.params = params
+        # pipelined-exchange machinery (begin_push_pull/take_result)
+        self._thread: Optional[threading.Thread] = None
+        self._jobs = None
+        self._job: Optional[dict] = None
 
     def push_pull(self, new_params: Any) -> Any:
+        if self._job is not None:
+            # both paths read/write self._snapshot; mixing them while an
+            # exchange is in flight would double-push the shared delta
+            raise RuntimeError("a pipelined exchange is in flight; "
+                               "take_result() before a synchronous push_pull")
         new_leaves = [np.asarray(l) for l in jax.tree_util.tree_leaves(new_params)]
         pulled = []
         for name, new, snap in zip(self._names, new_leaves, self._snapshot):
@@ -259,3 +268,93 @@ class AsyncWorker:
         self._snapshot = [p.copy() for p in pulled]
         self.params = jax.tree_util.tree_unflatten(self.treedef, pulled)
         return self.params
+
+    # ------------------------------------------------- pipelined exchange
+
+    def begin_push_pull(self, device_params: Any) -> None:
+        """Start an exchange in the background (the no-waiting rendering of
+        the reference's async loop): the worker thread device_gets the
+        given (non-donated!) param copies, pushes the delta vs the last
+        snapshot, pulls the global state, and parks the result for
+        ``take_result``.  The train thread keeps dispatching steps — no
+        host sync on its critical path."""
+        if self._job is not None:
+            raise RuntimeError("an exchange is already in flight; "
+                               "take_result() first")
+        self._ensure_thread()
+        job = {"params": device_params, "done": threading.Event(),
+               "pulled": None, "submitted": None, "error": None}
+        self._job = job
+        self._jobs.put(job)
+
+    def exchange_in_flight(self) -> bool:
+        return self._job is not None
+
+    def take_result(self, timeout: Optional[float] = 120.0):
+        """Wait for the in-flight exchange; returns ``(pulled, submitted)``
+        pytrees (host arrays) or None when nothing is in flight.
+
+        The caller adopts with the catch-up rule
+        ``params += pulled - submitted``: the worker kept training while
+        the exchange flew, so the raw pulled state is missing its local
+        progress since submit — adding the difference folds the global
+        update into the *current* params without losing that work (the
+        next exchange's delta picks it up from the new snapshot)."""
+        job, self._job = self._job, None
+        if job is None:
+            return None
+        if not job["done"].wait(timeout):
+            self._job = job  # still in flight; caller may retry
+            raise TimeoutError("async-PS exchange did not complete")
+        if job["error"] is not None:
+            raise job["error"]
+        return job["pulled"], job["submitted"]
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None:
+            import queue as queue_mod
+
+            self._jobs: "queue_mod.Queue" = queue_mod.Queue()
+            self._thread = threading.Thread(
+                target=self._exchange_loop,
+                name=f"bps-async-ps-{self.worker_id}", daemon=True)
+            self._thread.start()
+
+    def close(self) -> None:
+        """Stop the exchange thread (it holds a reference to this worker —
+        and thus a full host param snapshot — until stopped).  Safe to
+        call repeatedly; a still-in-flight job is drained first."""
+        if self._job is not None:
+            try:
+                self.take_result()
+            except Exception:
+                pass
+        if self._thread is not None:
+            self._jobs.put(None)
+            self._thread.join(timeout=10.0)
+            self._thread = None
+            self._jobs = None
+
+    def _exchange_loop(self) -> None:
+        while True:
+            job = self._jobs.get()
+            if job is None:
+                return
+            try:
+                leaves = [np.asarray(jax.device_get(l)) for l in
+                          jax.tree_util.tree_leaves(job["params"])]
+                pulled = []
+                for name, new, snap in zip(self._names, leaves,
+                                           self._snapshot):
+                    pulled.append(self.server.push_pull(name, new - snap))
+                self._snapshot = [p.copy() for p in pulled]
+                self.params = jax.tree_util.tree_unflatten(
+                    self.treedef, pulled)
+                job["pulled"] = jax.tree_util.tree_unflatten(
+                    self.treedef, pulled)
+                job["submitted"] = jax.tree_util.tree_unflatten(
+                    self.treedef, leaves)
+            except Exception as e:  # surfaced at take_result
+                job["error"] = e
+            finally:
+                job["done"].set()

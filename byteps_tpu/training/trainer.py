@@ -19,6 +19,7 @@ import time
 from typing import Callable, Dict, Iterable, Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import optax
 
 import byteps_tpu as bps
@@ -192,15 +193,18 @@ class Trainer:
                     # so checkpoint step numbers stay consistent.
                     start_step = int(state.step) - seen
             if self._async_worker is not None and seen % self.async_interval == 0:
-                # async-PS exchange: push this worker's weight delta, adopt
-                # the pulled global state (reference torch/__init__.py:
-                # 174-189 — params = pull(push(params - last_pulled)))
-                pulled = self._async_worker.push_pull(
-                    jax.device_get(state.params)
-                )
-                state = state._replace(
-                    params=replicate_state(pulled, self.mesh)
-                )
+                # Pipelined async-PS exchange (reference torch/__init__.py:
+                # 174-189, kept off the critical path): adopt the PREVIOUS
+                # interval's pulled global state with the catch-up rule
+                # params += pulled - submitted (local progress made while
+                # the exchange flew is preserved; see AsyncWorker), then
+                # submit this interval's exchange on a non-donated device
+                # copy.  The train thread never blocks on device_get.
+                state = self._adopt_exchange(state)
+                # non-donated copy: the step donates state buffers, so the
+                # background thread must not read state.params directly
+                self._async_worker.begin_push_pull(
+                    jax.tree_util.tree_map(jnp.copy, state.params))
             if self.log_every and seen % self.log_every == 0:
                 avg = average_metrics(
                     {k: v for k, v in metrics.items()}
@@ -210,11 +214,35 @@ class Trainer:
                     "step %d %s (%.2f steps/s)", step_no,
                     {k: round(v, 4) for k, v in avg.items()}, rate,
                 )
+        if self._async_worker is not None:
+            # drain the last in-flight exchange so the returned state
+            # reflects the global store
+            state = self._adopt_exchange(state)
         if self.overlap:
             # apply the final pending (1-step-stale) gradients
             state = self.step_fn.flush(state)
         self.state = state
         return state
+
+    def close(self) -> None:
+        """Release background resources (the async-PS exchange thread,
+        which pins a host param snapshot until stopped).  Idempotent."""
+        if self._async_worker is not None:
+            self._async_worker.close()
+            self._async_worker = None
+
+    def _adopt_exchange(self, state):
+        """Fold a completed background exchange into the current params:
+        ``params += pulled - submitted`` (catch-up rule — see
+        AsyncWorker.take_result).  No-op when nothing is in flight."""
+        if not self._async_worker.exchange_in_flight():
+            return state
+        pulled, submitted = self._async_worker.take_result()
+        new_params = jax.tree_util.tree_map(
+            lambda x, p, s: x + replicate_state(
+                jnp.asarray(p - s), self.mesh).astype(x.dtype),
+            state.params, pulled, submitted)
+        return state._replace(params=new_params)
 
     def evaluate(self, eval_fn: Callable, batches: Iterable) -> Dict[str, float]:
         """Average ``eval_fn(state, batch) -> {metric: scalar}`` over

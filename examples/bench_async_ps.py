@@ -1,0 +1,103 @@
+"""Async-PS exchange benchmark: synchronous (r2's stop-the-world
+device_get + per-tensor RPC on the train thread) vs pipelined (r3:
+background push/pull thread + double-buffered catch-up adopt).
+
+Four worker threads share a ShardedParameterStore whose push_pull carries
+an injected per-call latency (emulating the server-tier RTT the reference
+pays over ps-lite).  Each worker runs local SGD toward a fixed target and
+exchanges every ``--interval`` steps.  Reported per mode: aggregate
+steps/sec, the worst single-step wall time on the train thread (the
+"stall" the pipelined mode exists to remove), and final distance to the
+target (convergence is equivalent — the exchange algebra is identical,
+only its placement moves).
+
+    python examples/bench_async_ps.py --steps 200 --latency-ms 5
+"""
+
+import argparse
+import json
+import threading
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workers", type=int, default=4)
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--interval", type=int, default=2)
+    ap.add_argument("--latency-ms", type=float, default=5.0)
+    ap.add_argument("--dim", type=int, default=100_000)
+    args = ap.parse_args()
+
+    import jax.numpy as jnp
+
+    from byteps_tpu.engine.async_ps import AsyncWorker, ShardedParameterStore
+
+    target = np.linspace(-1, 1, args.dim).astype(np.float32)
+    lr = 0.05
+
+    class SlowStore(ShardedParameterStore):
+        def push_pull(self, name, delta):
+            time.sleep(args.latency_ms / 1e3)
+            return super().push_pull(name, delta)
+
+    def run(mode: str):
+        store = SlowStore(num_shards=2, use_native=False)
+        p0 = {"w": np.zeros(args.dim, np.float32)}
+        workers = [AsyncWorker(store, p0, worker_id=i)
+                   for i in range(args.workers)]
+        worst_step = [0.0] * args.workers
+        final = [None] * args.workers
+
+        def work(idx, w):
+            params = np.zeros(args.dim, np.float32)
+            for it in range(args.steps):
+                t0 = time.perf_counter()
+                params = params - lr * (params - target)   # local step
+                if (it + 1) % args.interval == 0:
+                    if mode == "sync":
+                        pulled = w.push_pull({"w": jnp.asarray(params)})
+                        params = np.asarray(pulled["w"]).copy()
+                    else:
+                        if w.exchange_in_flight():
+                            pulled, sub = w.take_result()
+                            params = params + (pulled["w"] - sub["w"])
+                        w.begin_push_pull({"w": jnp.asarray(params)})
+                worst_step[idx] = max(worst_step[idx],
+                                      time.perf_counter() - t0)
+            if mode != "sync" and w.exchange_in_flight():
+                pulled, sub = w.take_result()
+                params = params + (pulled["w"] - sub["w"])
+            final[idx] = params
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=work, args=(i, w))
+                   for i, w in enumerate(workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        err = max(float(np.abs(f - target).max()) for f in final)
+        return {
+            "metric": f"async_ps_{mode}_steps_per_sec",
+            "value": round(args.workers * args.steps / wall, 2),
+            "unit": "steps/sec",
+            "wall_sec": round(wall, 3),
+            "worst_train_thread_step_ms": round(max(worst_step) * 1e3, 2),
+            "final_max_err": round(err, 4),
+            "workers": args.workers,
+            "exchange_latency_ms": args.latency_ms,
+        }
+
+    sync = run("sync")
+    print(json.dumps(sync), flush=True)
+    piped = run("pipelined")
+    piped["vs_sync"] = round(piped["value"] / sync["value"], 3)
+    print(json.dumps(piped), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -273,3 +273,122 @@ def test_server_with_native_reducer():
     w = AsyncWorker(server, {"w": np.zeros(1000, np.float32)})
     out = w.push_pull({"w": np.ones(1000, np.float32)})
     np.testing.assert_allclose(out["w"], 1.0)
+
+
+def test_pipelined_exchange_catch_up_rule():
+    """begin_push_pull/take_result (VERDICT r3 #7): the background
+    exchange returns (pulled, submitted); adopting with
+    params += pulled - submitted preserves local progress made while the
+    exchange was in flight."""
+    server = AsyncParameterServer(use_native=False)
+    w = AsyncWorker(server, {"p": np.zeros(4, np.float32)})
+    other = AsyncWorker(server, {"p": np.zeros(4, np.float32)})
+
+    w.begin_push_pull({"p": jnp.ones(4, jnp.float32)})       # delta +1
+    pulled, submitted = w.take_result()
+    np.testing.assert_allclose(pulled["p"], 1.0)
+    np.testing.assert_allclose(submitted["p"], 1.0)
+
+    # another worker contributes +2 BEFORE our second exchange is queued
+    # (ordering fixed so the expected pulled value is deterministic)
+    other.push_pull({"p": np.full(4, 2.0, np.float32)})         # delta +2
+    w.begin_push_pull({"p": jnp.full((4,), 1.5, jnp.float32)})  # delta +0.5
+    pulled, submitted = w.take_result()
+    # local trained on to 1.7 while the exchange flew; catch-up keeps the
+    # 0.2 of local progress on top of the pulled global state
+    current = np.full(4, 1.7, np.float32)
+    adopted = current + (pulled["p"] - submitted["p"])
+    np.testing.assert_allclose(pulled["p"], 3.5)  # 0 +1 +2 +0.5
+    np.testing.assert_allclose(adopted, 3.5 + 0.2, rtol=1e-6)
+
+    # double-submit without take_result is an error; so is a synchronous
+    # push_pull while an exchange is in flight
+    w.begin_push_pull({"p": jnp.zeros(4)})
+    with pytest.raises(RuntimeError):
+        w.begin_push_pull({"p": jnp.zeros(4)})
+    with pytest.raises(RuntimeError):
+        w.push_pull({"p": np.zeros(4, np.float32)})
+    w.take_result()
+    w.close()
+    other.close()
+
+
+def test_four_workers_pipelined_converge():
+    """4 workers with the PIPELINED exchange (train while the delta is in
+    flight) still converge to the target — same contract as the
+    synchronous-exchange test above."""
+    from byteps_tpu.engine.async_ps import ShardedParameterStore
+
+    store = ShardedParameterStore(num_shards=2, use_native=False)
+    target = np.arange(4, dtype=np.float32)
+    p0 = {"w": np.zeros(4, np.float32)}
+    workers = [AsyncWorker(store, p0, worker_id=i) for i in range(4)]
+    lr = 0.05
+
+    def work(w):
+        params = np.zeros(4, np.float32)
+        for it in range(80):
+            params = params - lr * (params - target)   # local step
+            if w.exchange_in_flight():
+                pulled, submitted = w.take_result()
+                params = params + (pulled["w"] - submitted["w"])
+            w.begin_push_pull({"w": jnp.asarray(params)})
+        if w.exchange_in_flight():
+            pulled, submitted = w.take_result()
+            params = params + (pulled["w"] - submitted["w"])
+        return params
+
+    threads = [threading.Thread(target=work, args=(w,)) for w in workers]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for w in workers:
+        w.push_pull(w.params)  # settle + read the global state
+        np.testing.assert_allclose(w.params["w"], target, atol=5e-2)
+
+
+def test_trainer_pipelined_async_no_trainloop_device_get(monkeypatch):
+    """The trainer's exchange path must not call jax.device_get on the
+    train thread (the r2 stop-the-world stall): device_get happens only on
+    the background exchange thread."""
+    from byteps_tpu.engine.async_ps import (AsyncParameterServer,
+                                            reset_async_store,
+                                            set_async_store)
+    from byteps_tpu.training.trainer import Trainer
+
+    main_thread = threading.current_thread()
+    calls = []
+    orig = jax.device_get
+
+    def spy(x):
+        if threading.current_thread() is main_thread:
+            calls.append(1)
+        return orig(x)
+
+    store = AsyncParameterServer(use_native=False)
+    set_async_store(store)
+    try:
+        def loss_fn(params, mstate, batch):
+            pred = batch["x"] @ params["w"]
+            return jnp.mean((pred - batch["y"]) ** 2), mstate
+
+        trainer = Trainer(loss_fn, optax.sgd(0.1), log_every=0,
+                          async_mode=True, async_interval=2)
+        w_true = jnp.array([1.0, -2.0])
+        x = jax.random.normal(jax.random.PRNGKey(0), (16, 2))
+        data = [{"x": x, "y": x @ w_true}] * 20
+        # init first: AsyncWorker registration does one legitimate
+        # device_get outside the train loop
+        trainer.state = trainer.init_state({"w": jnp.zeros(2)}, {})
+        monkeypatch.setattr(jax, "device_get", spy)
+        state = trainer.fit({"w": jnp.zeros(2)}, {}, iter(data), steps=20)
+        monkeypatch.undo()
+        assert not calls, "train thread called jax.device_get"
+        # converged and the store saw the pushes
+        np.testing.assert_allclose(np.asarray(state.params["w"]),
+                                   np.asarray(w_true), atol=1e-2)
+        assert store.names()
+        trainer.close()  # stops the exchange thread (frees the snapshot)
+    finally:
+        reset_async_store()
