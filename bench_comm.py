@@ -1,0 +1,236 @@
+"""Comm-visible benchmark matrix (VERDICT r2 #4): every point runs on a
+virtual 8-device ``dcn×dp`` mesh (the ``BYTEPS_FORCE_DISTRIBUTED``
+harness), so collectives do real work and the numbers expose what the
+single-chip bench.py cannot:
+
+  * **bucket-size sweep** — the scheduled DP train step at 1/4/16 MB
+    partition_bytes, with a measured **comm fraction** per point (step
+    time vs the identical local-update step with no collectives);
+  * **scheduled vs unscheduled priority order** on the eager engine — the
+    runtime ScheduledQueue drains gradient-sized tensors arriving in
+    backward order (last layer first) either with reference priorities
+    (earlier-declared = higher priority — what the next forward needs
+    first) or with reversed priorities; reported as time-to-first-needed
+    (layer 0) and full drain — the metric ByteScheduler optimizes
+    (bytescheduler/torch/optimizer.py:180-214);
+  * **jit bucket order** — the same DP step with the BucketPlan's
+    schedule_order reversed, showing the traced path's order sensitivity
+    (XLA owns the final schedule there; the eager path is where runtime
+    order matters — this line quantifies both honestly).
+
+Prints ONE JSON line per point.  Runs anywhere (CPU virtual mesh by
+construction):  python bench_comm.py [--layers 8 --dim 1024]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
+
+
+def _time(fn, state, batch, iters, warmup=2):
+    for _ in range(warmup):
+        state, m = fn(state, batch)
+    jax.block_until_ready(m)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, m = fn(state, batch)
+    jax.block_until_ready((m, state))
+    return (time.perf_counter() - t0) / iters, state
+
+
+def bucket_sweep(mesh, layers, dim, iters):
+    from byteps_tpu.parallel.collectives import shard_map
+    from byteps_tpu.training import make_data_parallel_step, shard_batch
+
+    def loss_fn(params, mstate, batch):
+        h = batch["x"]
+        for i in range(layers):
+            h = jnp.tanh(h @ params[f"w{i}"])
+        return jnp.mean((h[:, 0] - batch["y"]) ** 2), mstate
+
+    params = {f"w{i}": jnp.full((dim, dim), 0.01, jnp.float32)
+              for i in range(layers)}
+    tx = optax.sgd(0.01)
+    batch = shard_batch(
+        {"x": jnp.ones((64, dim)), "y": jnp.zeros((64,))}, mesh,
+        axes=("dcn", "dp"))
+
+    # local-update analog: same mesh, same per-device compute, NO
+    # collectives — the denominator of the comm fraction
+    def local_step(state, b):
+        p, o = state
+
+        def lf(pp):
+            return loss_fn(pp, {}, b)[0]
+
+        loss, g = jax.value_and_grad(lf)(p)
+        upd, o = tx.update(g, o, p)
+        return (optax.apply_updates(p, upd), o), {"loss": loss}
+
+    local_jit = jax.jit(shard_map(
+        local_step, mesh, in_specs=((P(), P()), P(("dcn", "dp"))),
+        out_specs=((P(), P()), P())), donate_argnums=(0,))
+    # own copy: local_jit donates its state, and params seeds the bucketed
+    # steps below too
+    t_local, _ = _time(
+        local_jit,
+        (jax.tree_util.tree_map(jnp.copy, params), tx.init(params)),
+        batch, iters)
+
+    out = []
+    for mb in (1, 4, 16):
+        step = make_data_parallel_step(
+            loss_fn, tx, mesh, axes=("dcn", "dp"),
+            partition_bytes=mb * 1024 * 1024)
+        state = step.init_state(jax.tree_util.tree_map(jnp.copy, params))
+        t, _ = _time(step, state, batch, iters)
+        out.append({
+            "metric": f"dp_step_bucket_{mb}mb_ms",
+            "value": round(t * 1e3, 2),
+            "unit": "ms/step",
+            "comm_fraction": round(max(0.0, 1 - t_local / t), 4),
+            "ms_per_step_local_only": round(t_local * 1e3, 2),
+            "mesh": "dcn2_dp4" if "dcn" in mesh.axis_names else "dp8",
+        })
+        print(json.dumps(out[-1]), flush=True)
+    return out
+
+
+def eager_priority_order(mesh, n_tensors, mbytes, iters):
+    """Drain gradient-sized tensors arriving in backward order through the
+    real engine, with reference priorities vs reversed priorities."""
+    import byteps_tpu as bps
+    from byteps_tpu.engine import dispatcher as _dispatcher
+
+    bps.init(mesh=mesh)
+    engine = _dispatcher.get_engine()
+    world = engine.world
+    elems = mbytes * 1024 * 1024 // 4
+    x = jnp.ones((world, elems), jnp.float32)
+    jax.block_until_ready(x)
+
+    def drain(prio_sign, tag, rep):
+        handles = {}
+        t0 = time.perf_counter()
+        # backward produces the LAST layer's gradient first
+        for i in reversed(range(n_tensors)):
+            handles[i] = engine.push_pull_async(
+                x, f"CommBench{tag}{rep}.layer{i}", average=True,
+                priority=prio_sign * (n_tensors - i))
+        engine.synchronize(handles[0])      # layer 0: needed first by the
+        t_first = time.perf_counter() - t0  # next forward
+        for i in range(1, n_tensors):
+            engine.synchronize(handles[i])
+        return t_first, time.perf_counter() - t0
+
+    # warmup (compiles the stacked reduce)
+    drain(+1, "warm", 0)
+    sched_first = unsched_first = float("inf")
+    sched_all = unsched_all = float("inf")
+    for r in range(iters):
+        tf, ta = drain(+1, "sched", r)      # reference: layer 0 highest
+        sched_first, sched_all = min(sched_first, tf), min(sched_all, ta)
+        tf, ta = drain(-1, "rev", r)        # reversed: arrival order wins
+        unsched_first, unsched_all = (min(unsched_first, tf),
+                                      min(unsched_all, ta))
+    res = {
+        "metric": "eager_first_needed_gradient_ms",
+        "value": round(sched_first * 1e3, 2),
+        "unit": "ms",
+        "unscheduled_ms": round(unsched_first * 1e3, 2),
+        "vs_unscheduled": round(unsched_first / sched_first, 3),
+        "drain_all_ms": round(sched_all * 1e3, 2),
+        "drain_all_unscheduled_ms": round(unsched_all * 1e3, 2),
+        "tensors": n_tensors,
+        "mbytes_each": mbytes,
+    }
+    print(json.dumps(res), flush=True)
+    return res
+
+
+def jit_bucket_order(mesh, layers, dim, iters):
+    """Reversed BucketPlan.schedule_order inside the traced step: XLA owns
+    the final schedule, so ~1.0 is the expected (and honest) result."""
+    from byteps_tpu.common import partition as partition_mod
+    from byteps_tpu.training import make_data_parallel_step, shard_batch
+
+    def loss_fn(params, mstate, batch):
+        h = batch["x"]
+        for i in range(layers):
+            h = jnp.tanh(h @ params[f"w{i}"])
+        return jnp.mean((h[:, 0] - batch["y"]) ** 2), mstate
+
+    params = {f"w{i}": jnp.full((dim, dim), 0.01, jnp.float32)
+              for i in range(layers)}
+    tx = optax.sgd(0.01)
+    batch = shard_batch(
+        {"x": jnp.ones((64, dim)), "y": jnp.zeros((64,))}, mesh,
+        axes=("dcn", "dp"))
+
+    def build(reverse):
+        orig = partition_mod.BucketPlan.schedule_order
+        if reverse:
+            partition_mod.BucketPlan.schedule_order = \
+                lambda self: list(reversed(orig(self)))
+        try:
+            step = make_data_parallel_step(
+                loss_fn, tx, mesh, axes=("dcn", "dp"),
+                partition_bytes=4 * 1024 * 1024, donate=False)
+            state = step.init_state(
+                jax.tree_util.tree_map(jnp.copy, params))
+            # schedule_order is consulted at TRACE time (push_pull_tree
+            # runs under jit on the first call) — trace while the patch
+            # is live or the reversed variant silently uses the original
+            jax.block_until_ready(step(state, batch))
+            return step, state
+        finally:
+            partition_mod.BucketPlan.schedule_order = orig
+
+    step_s, st_s = build(False)
+    t_sched, _ = _time(step_s, st_s, batch, iters)
+    step_r, st_r = build(True)
+    t_rev, _ = _time(step_r, st_r, batch, iters)
+    res = {
+        "metric": "jit_bucket_order_scheduled_ms",
+        "value": round(t_sched * 1e3, 2),
+        "unit": "ms/step",
+        "reversed_ms": round(t_rev * 1e3, 2),
+        "vs_reversed": round(t_rev / t_sched, 3),
+    }
+    print(json.dumps(res), flush=True)
+    return res
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--layers", type=int, default=8)
+    ap.add_argument("--dim", type=int, default=1024)
+    ap.add_argument("--iters", type=int, default=8)
+    ap.add_argument("--eager-tensors", type=int, default=12)
+    ap.add_argument("--eager-mbytes", type=int, default=8)
+    ap.add_argument("--eager-iters", type=int, default=3)
+    args = ap.parse_args()
+
+    from byteps_tpu.parallel.mesh import build_mesh
+
+    mesh = build_mesh(force_distributed=True)   # dcn(2) x dp(4)
+    bucket_sweep(mesh, args.layers, args.dim, args.iters)
+    jit_bucket_order(mesh, args.layers, args.dim, args.iters)
+    eager_priority_order(mesh, args.eager_tensors, args.eager_mbytes,
+                         args.eager_iters)
+
+
+if __name__ == "__main__":
+    main()

@@ -152,8 +152,12 @@ class Engine:
         counter = [len(parts)]
         for i, (off_b, len_b) in enumerate(parts):
             off_e, len_e = off_b // itemsize, len_b // itemsize
-            payload = jax.lax.slice_in_dim(flat, off_e, off_e + len_e, axis=1) \
-                if len(parts) > 1 else flat
+            # multi-partition payloads carry the WHOLE flat buffer plus
+            # their slice bounds; the dispatcher thread slices at launch.
+            # Enqueue must stay cheap — it runs on the caller's backward
+            # path, the reference's grad-hook requirement (slicing here
+            # serialized ~100 ms/tensor of device work into enqueue).
+            payload = flat if len(parts) == 1 else (flat, off_e, len_e)
             task = TensorTaskEntry(
                 name=f"{name}_{i}" if len(parts) > 1 else name,
                 key=partition_key(ctx.declared_key, i),
@@ -223,10 +227,14 @@ class Engine:
                 self.queue.report_finish(task)
 
     def _launch(self, task: TensorTaskEntry) -> jax.Array:
+        payload = task.payload
+        if isinstance(payload, tuple):  # deferred partition slice
+            flat, off_e, len_e = payload
+            payload = jax.lax.slice_in_dim(flat, off_e, off_e + len_e, axis=1)
         if self.world == 1 or getattr(task, "identity", False):
-            return task.payload[0]
+            return payload[0]
         return collectives.push_pull_stacked(
-            task.payload,
+            payload,
             self.mesh,
             self.reduce_axes,
             average=getattr(task, "average", False),
