@@ -113,6 +113,11 @@ def _time_pair(fn_a, state_a, fn_b, state_b, batch, iters=None,
         state_a, ma = fn_a(state_a, batch)
         state_b, mb = fn_b(state_b, batch)
     readback_barrier(ma, mb)
+    # one throwaway chunk per side: the first timed chunk otherwise absorbs
+    # lingering warm-up (autotuner / tunnel queue priming) — observed +50%
+    # on chunk 0 even after the per-step warmup above
+    _, state_a = _time_chunk(fn_a, state_a, batch, iters)
+    _, state_b = _time_chunk(fn_b, state_b, batch, iters)
     best_a = best_b = float("inf")
     for r in range(repeats):
         if r % 2 == 0:

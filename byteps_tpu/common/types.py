@@ -180,7 +180,10 @@ class TensorTaskEntry:
     partition_index: int = 0
     queue_list: list = field(default_factory=list)
     # engine-facing fields
-    payload: object = None  # jax.Array / np.ndarray chunk
+    # payload: the array chunk for single-partition tasks, or a deferred
+    # (flat_array, offset_elems, length_elems) tuple for multi-partition
+    # tasks — the dispatcher slices at launch time (engine/dispatcher.py)
+    payload: object = None
     output: object = None
     callback: Optional[object] = None
     counter_ref: Optional[list] = None  # shared [int] across partitions

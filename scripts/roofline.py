@@ -1,0 +1,120 @@
+"""Chip roofline microbenchmark: measured MXU peak and HBM bandwidth.
+
+Grounds docs/performance.md's MFU-ceiling analysis: the per-model MFU
+numbers in bench.py only mean something relative to what *this* chip
+actually sustains on (a) a large dense bf16/fp32 matmul (the practical
+MXU ceiling through this runtime) and (b) a pure streaming elementwise
+op (the practical HBM ceiling that bounds BatchNorm/ReLU/residual-add
+traffic in the vision models).
+
+Methodology: each point runs ITERS iterations as ONE jitted
+``lax.fori_loop`` whose carry feeds the next iteration (a true data
+dependency — a Python loop of independent dispatches reads ~4x slow on
+the tunneled runtime, and a loop without the dependency gets hoisted by
+XLA), ended by a value readback barrier.
+
+Prints one JSON line per point:
+  {"metric": "mxu_bf16_tflops", "value": ..., "frac_of_peak": ...}
+  {"metric": "hbm_gbps", "value": ...}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.lax as lax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+from byteps_tpu.common.timing import readback_barrier  # noqa: E402
+
+ITERS = 30
+
+
+def _time_chain(step, carry):
+    """sec/iter for ``step`` (carry -> carry) run ITERS times in one jit."""
+
+    @jax.jit
+    def chain(carry):
+        return lax.fori_loop(0, ITERS, lambda _, c: step(c), carry)
+
+    out = chain(carry)
+    out = chain(out)  # warm (compile + autotune + tunnel)
+    readback_barrier(out)
+    t0 = time.perf_counter()
+    out = chain(out)
+    readback_barrier(out)
+    return (time.perf_counter() - t0) / ITERS
+
+
+def peak_from_device() -> float | None:
+    # single source of truth for the chip-peak table: bench.py
+    from bench import _chip_peak_flops
+
+    return _chip_peak_flops()
+
+
+def main():
+    dev = jax.devices()[0]
+    print(json.dumps({"device_kind": dev.device_kind,
+                      "platform": dev.platform}), flush=True)
+    peak = peak_from_device()
+
+    # (a) MXU ceiling: large square matmul chain a <- (a @ b) / sqrt(n)
+    for dtype, tag, n in ((jnp.bfloat16, "bf16", 8192),
+                          (jnp.float32, "fp32", 4096)):
+        a = jax.random.normal(jax.random.PRNGKey(0), (n, n), dtype)
+        b = jax.random.normal(jax.random.PRNGKey(1), (n, n), dtype)
+        inv = jnp.asarray(1.0 / (n ** 0.5), dtype)
+
+        t = _time_chain(lambda c: ((c[0] @ c[1]) * inv, c[1]), (a, b))
+        tflops = 2 * n ** 3 / t / 1e12
+        row = {"metric": f"mxu_{tag}_tflops", "value": round(tflops, 1),
+               "n": n, "ms": round(t * 1e3, 3)}
+        if peak and tag == "bf16":
+            row["frac_of_peak"] = round(tflops * 1e12 / peak, 3)
+        print(json.dumps(row), flush=True)
+
+    # (b) HBM ceiling: streaming chain x <- x * c + y (2 reads + 1 write)
+    nelem = 256 * 1024 * 1024 // 4  # 256 MB fp32 per array
+    x = jnp.ones((nelem,), jnp.float32)
+    y = jnp.full((nelem,), 1e-7, jnp.float32)
+
+    t = _time_chain(lambda c: (c[0] * 0.999 + c[1], c[1]), (x, y))
+    gbps = 3 * nelem * 4 / t / 1e9
+    print(json.dumps({"metric": "hbm_gbps", "value": round(gbps, 1),
+                      "ms": round(t * 1e3, 3)}), flush=True)
+
+    # (c) the ResNet hot shape: conv as matmul at the channel widths the
+    # model actually runs (im2col rows x (9 c_in) @ (9 c_in) x c_out) —
+    # shows where the vision MFU ceiling comes from.  The chain feeds a
+    # tiny slice of the output back into the weights (negligible extra
+    # traffic, preserves the data dependency).
+    for c_in, c_out, hw, tag in ((64, 64, 56, "stage1"),
+                                 (512, 512, 7, "stage4")):
+        rows = 64 * hw * hw  # b64 feature-map positions
+        k = c_in * 9
+        a = jax.random.normal(jax.random.PRNGKey(2), (rows, k), jnp.bfloat16)
+        w = jax.random.normal(jax.random.PRNGKey(3), (k, c_out), jnp.bfloat16)
+
+        def conv_step(c, rows=rows, k=k, c_out=c_out):
+            a, w = c
+            out = a @ w
+            w = w + out[:1, :] * jnp.asarray(1e-8, jnp.bfloat16)
+            return a, w
+
+        t = _time_chain(conv_step, (a, w))
+        tflops = 2 * rows * k * c_out / t / 1e12
+        row = {"metric": f"conv3x3_{tag}_im2col_tflops",
+               "value": round(tflops, 1), "rows": rows,
+               "k": k, "n": c_out, "ms": round(t * 1e3, 3)}
+        if peak:
+            row["frac_of_peak"] = round(tflops * 1e12 / peak, 3)
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()
