@@ -48,7 +48,7 @@ from byteps_tpu.training.step import replicate_state
 
 WARMUP = 3      # post-AOT-compile warmup (runtime path only)
 ITERS = 30      # per timed chunk (scaled down in CPU smoke mode)
-REPEATS = 5     # interleaved best-of-N chunks (timing is cheap next to
+REPEATS = 6     # interleaved best-of-N chunks (timing is cheap next to
                 # compiles; r02's REPEATS=3 let chip-clock drift print a
                 # spurious 3.7% bf16 "regression" for two HLO-identical
                 # programs)
@@ -119,18 +119,32 @@ def _time_pair(fn_a, state_a, fn_b, state_b, batch, iters=None,
     _, state_a = _time_chunk(fn_a, state_a, batch, iters)
     _, state_b = _time_chunk(fn_b, state_b, batch, iters)
     best_a = best_b = float("inf")
+    round_ratios = []
     for r in range(repeats):
         if r % 2 == 0:
-            dt, state_a = _time_chunk(fn_a, state_a, batch, iters)
-            best_a = min(best_a, dt)
-            dt, state_b = _time_chunk(fn_b, state_b, batch, iters)
-            best_b = min(best_b, dt)
+            dt_a, state_a = _time_chunk(fn_a, state_a, batch, iters)
+            dt_b, state_b = _time_chunk(fn_b, state_b, batch, iters)
         else:
-            dt, state_b = _time_chunk(fn_b, state_b, batch, iters)
-            best_b = min(best_b, dt)
-            dt, state_a = _time_chunk(fn_a, state_a, batch, iters)
-            best_a = min(best_a, dt)
-    return best_a, best_b
+            dt_b, state_b = _time_chunk(fn_b, state_b, batch, iters)
+            dt_a, state_a = _time_chunk(fn_a, state_a, batch, iters)
+        best_a = min(best_a, dt_a)
+        best_b = min(best_b, dt_b)
+        round_ratios.append(dt_b / dt_a)
+    # Drift- and order-robust ratio: the tunnel's dispatch speed drifts
+    # slowly (2x across sessions on the ~0.5 ms dispatch-bound config) and
+    # whichever program runs second in a round sees a slightly different
+    # regime.  Adjacent ab/ba round pairs see the same drift with opposite
+    # order, so the geometric mean of each pair cancels both; the median
+    # over pairs rejects outlier rounds.
+    pair_ratios = [
+        (round_ratios[i] * round_ratios[i + 1]) ** 0.5
+        for i in range(0, len(round_ratios) - 1, 2)
+    ] or round_ratios
+    pair_ratios.sort()
+    n = len(pair_ratios)
+    med = (pair_ratios[n // 2] if n % 2 else
+           0.5 * (pair_ratios[n // 2 - 1] + pair_ratios[n // 2]))
+    return best_a, best_b, med
 
 
 def _hlo_op_histogram(compiled) -> dict:
@@ -153,10 +167,15 @@ def _hlo_op_histogram(compiled) -> dict:
 
 def _make_plain_step(loss_fn, tx, mesh):
     """The no-scheduler Horovod analog: naive jax.grad + pmean in one SPMD
-    program, same model/optimizer/batch layout."""
+    program, same model/optimizer/batch layout.  The state carries a
+    global-step counter like any real training loop (flax's canonical
+    TrainState has ``.step``) — without it the two programs differ by one
+    device buffer per call, which on the tunneled runtime's
+    dispatch-bound configs reads as a spurious 10-20% framework "loss"
+    that is really just per-buffer dispatch cost."""
 
     def plain_local(state, batch):
-        params, opt_state, mstate = state
+        params, opt_state, mstate, gstep = state
 
         def lf(p):
             return loss_fn(p, mstate, batch)
@@ -170,7 +189,8 @@ def _make_plain_step(loss_fn, tx, mesh):
             if jnp.issubdtype(x.dtype, jnp.floating) else x,
             new_mstate,
         )
-        return (params, opt_state, new_mstate), jax.lax.pmean(loss, "dp")
+        return ((params, opt_state, new_mstate, gstep + 1),
+                jax.lax.pmean(loss, "dp"))
 
     jitted = jax.jit(
         shard_map(plain_local, mesh, in_specs=(P(), P("dp")),
@@ -187,11 +207,19 @@ def _deep_copy(tree):
 
 def _run_config(name, unit, per_item_scale, model, loss_fn, tx, mesh, batch,
                 batch_size, analytic_flops_per_item, init_args, init_kwargs,
-                iters=None):
+                iters=None, repeats=None, device_loop=0):
     """Build framework + plain states, time both, return the result dict.
 
     ``per_item_scale`` converts items/step (batch rows) to the reported
     unit (1 for images, seq_len for tokens).
+
+    ``device_loop`` > 0 runs that many steps per host call inside one
+    ``lax.fori_loop`` (both sides) — for sub-millisecond steps, where the
+    per-call host dispatch on the tunneled runtime is 2x session-variable
+    and swamps the program: an A/A control (the plain program timed
+    against itself) showed a 2.7% spread with host-driven chunks, so
+    host-driven ratios are meaningless at that step size.  The device
+    loop measures pure device step rate, identically for both programs.
     """
     variables = model.init(jax.random.PRNGKey(0), *init_args, **init_kwargs)
     params = variables["params"]
@@ -205,7 +233,8 @@ def _run_config(name, unit, per_item_scale, model, loss_fn, tx, mesh, batch,
 
     plain_jit = _make_plain_step(loss_fn, tx, mesh)
     pstate = replicate_state(
-        (_deep_copy(params), tx.init(params), _deep_copy(mstate)), mesh
+        (_deep_copy(params), tx.init(params), _deep_copy(mstate),
+         jnp.zeros((), jnp.int32)), mesh
     )
     compiled_plain = plain_jit.lower(pstate, batch).compile()
 
@@ -220,14 +249,48 @@ def _run_config(name, unit, per_item_scale, model, loss_fn, tx, mesh, batch,
     except Exception:
         extra, total = None, None
 
-    def plain_compiled_fn(s, b):
-        s, loss = compiled_plain(s, b)
-        return s, {"loss": loss}
+    if device_loop:
+        K = device_loop
 
-    t_fw, t_plain = _time_pair(
-        lambda s, b: compiled_fw(s, b), state,
-        plain_compiled_fn, pstate, batch, iters,
-    )
+        def fw_loop(s):
+            def body(_, carry):
+                st, _m = carry
+                return step._fn(st, batch)
+
+            return jax.lax.fori_loop(
+                0, K, body, (s, {"loss": jnp.zeros((), jnp.float32)}))
+
+        def plain_loop(s):
+            def body(_, carry):
+                st, _l = carry
+                return plain_jit(st, batch)
+
+            return jax.lax.fori_loop(0, K, body, (s, jnp.zeros(())))
+
+        cfw_loop = jax.jit(fw_loop, donate_argnums=(0,)).lower(state).compile()
+        cpl_loop = jax.jit(plain_loop,
+                           donate_argnums=(0,)).lower(pstate).compile()
+
+        def fa(s, b):
+            s, m = cfw_loop(s)
+            return s, m
+
+        def fb(s, b):
+            s, l = cpl_loop(s)
+            return s, {"loss": l}
+
+        t_fw, t_plain, ratio = _time_pair(
+            fa, state, fb, pstate, batch, iters, repeats)
+        t_fw, t_plain = t_fw / K, t_plain / K
+    else:
+        def plain_compiled_fn(s, b):
+            s, loss = compiled_plain(s, b)
+            return s, {"loss": loss}
+
+        t_fw, t_plain, ratio = _time_pair(
+            lambda s, b: compiled_fw(s, b), state,
+            plain_compiled_fn, pstate, batch, iters, repeats,
+        )
     del state, pstate, params, mstate, variables, compiled_fw, compiled_plain
 
     peak = _chip_peak_flops()
@@ -237,7 +300,9 @@ def _run_config(name, unit, per_item_scale, model, loss_fn, tx, mesh, batch,
         "metric": name,
         "value": round(rate, 2),
         "unit": unit,
-        "vs_baseline": round(t_plain / t_fw, 4),
+        # drift-robust adjacent-pair median (see _time_pair); ms fields
+        # are each side's independent best and may disagree slightly
+        "vs_baseline": round(ratio, 4),
         "ms_per_step": round(t_fw * 1e3, 3),
         "ms_per_step_plain": round(t_plain * 1e3, 3),
     }
@@ -325,6 +390,11 @@ def main():
         bmodel, bert_loss, optax.adamw(1e-4), mesh, bbatch, bbatch_size,
         (6 * 110e6 * seq) if on_tpu else None,
         (jnp.zeros((bb, seq), jnp.int32),), {},
+        # ~23 ms step: measured run-to-run ratio spread is ~±1%, larger
+        # than the signal — longer chunks + extra ab/ba pairs pin the
+        # adjacent-pair median down
+        iters=45 if on_tpu else None,
+        repeats=12 if on_tpu else None,
     ))
     print(json.dumps(results[-1]), flush=True)
 
@@ -356,9 +426,12 @@ def main():
         f"mnist_mlp_b{mb}_images_per_sec{suffix}", "images/sec", 1,
         _Fn(), mlp_loss, optax.sgd(0.1, momentum=0.9), mesh, mbatch,
         mbatch_size, None, (), {},
-        # tiny program: per-step time is dispatch RTT on a tunneled
-        # runtime; long chunks average the jitter out of the ratio
-        iters=4 * ITERS,
+        # tiny program: per-step time would be dispatch RTT on the
+        # tunneled runtime (2x session-variable; A/A control spread 2.7%)
+        # — run 1920 steps per call on device instead and time that
+        iters=2 if on_tpu else 4 * ITERS,
+        repeats=12 if on_tpu else None,
+        device_loop=1920 if on_tpu else 0,
     ))
     print(json.dumps(results[-1]), flush=True)
     del mbatch
@@ -403,7 +476,7 @@ def main():
 
             return fn
 
-        t_flash, t_naive = _time_pair(
+        t_flash, t_naive, flash_ratio = _time_pair(
             attn_step("flash"), None, attn_step("naive"), None, qkv)
         # attention FLOPs: fwd = 2 matmuls * 2*B*H*T^2*D, halved by causal
         # masking; bwd ~ 2.5x fwd (4 matmuls + recompute) => total 3.5x
@@ -417,7 +490,7 @@ def main():
                        f"_tokens_per_sec{suffix}"),
             "value": round(fb * fT / t_flash, 2),
             "unit": "tokens/sec",
-            "vs_baseline": round(t_naive / t_flash, 4),
+            "vs_baseline": round(flash_ratio, 4),
             "ms_per_step": round(t_flash * 1e3, 3),
             "ms_per_step_plain": round(t_naive * 1e3, 3),
             "tflops_per_step": round(flops / 1e12, 4),

@@ -49,6 +49,9 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     causal: bool = True  # False => bidirectional encoder (BERT-style)
     attn_impl: str = "local"  # local | flash | ring | ulysses
+    # Mistral-style causal sliding window (flash impl only, no sp axis):
+    # each position attends to the last `attn_window` positions
+    attn_window: Optional[int] = None
     # mesh axis names; attention shard_map uses (dp_axis, sp_axis, tp_axis)
     dp_axis: str = "dp"
     sp_axis: str = "sp"
@@ -78,7 +81,13 @@ class TransformerConfig:
         if self.attn_impl == "flash" and not has_sp:
             from ..ops.flash_attention import flash_attention
 
-            return lambda q, k, v: flash_attention(q, k, v, causal=causal)
+            window = self.attn_window
+            return lambda q, k, v: flash_attention(q, k, v, causal=causal,
+                                                   window=window)
+        if self.attn_window is not None:
+            raise ValueError(
+                "attn_window requires attn_impl='flash' without an active "
+                f"sp axis (got attn_impl={self.attn_impl!r})")
         if self.attn_impl == "local" or self.mesh is None:
             return lambda q, k, v: local_attention(q, k, v, causal=causal)
         if self.attn_impl == "flash":
@@ -130,8 +139,13 @@ class Attention(nn.Module):
                 from ..ops.flash_attention import flash_attention
 
                 out = flash_attention(q, k, v, cfg.causal,
-                                      segment_ids=key_mask)
+                                      segment_ids=key_mask,
+                                      window=cfg.attn_window)
             else:
+                if cfg.attn_window is not None:
+                    raise ValueError(
+                        "attn_window requires attn_impl='flash' without an "
+                        f"active sp axis (got attn_impl={cfg.attn_impl!r})")
                 # sp-parallel impls don't take a mask; cfg.attention_fn
                 # raises first if an sp axis is active
                 out = local_attention(q, k, v, causal=cfg.causal,

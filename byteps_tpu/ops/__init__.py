@@ -3,9 +3,11 @@
 from .compression import BF16Compressor, Compression, Compressor, FP16Compressor, NoneCompressor
 from .flash_attention import flash_attention, flash_attention_with_lse
 from .fused_cross_entropy import fused_linear_cross_entropy
+from .sparsification import topk_ef_push_pull_gradients, topk_select
 
 __all__ = [
     "Compression", "Compressor", "NoneCompressor", "FP16Compressor", "BF16Compressor",
     "flash_attention", "flash_attention_with_lse",
     "fused_linear_cross_entropy",
+    "topk_ef_push_pull_gradients", "topk_select",
 ]

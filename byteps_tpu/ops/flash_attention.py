@@ -75,14 +75,37 @@ def _seg_mask(sq_ref, sk_ref, s):
     return jnp.where(valid, s, _NEG_INF)
 
 
+def _window_first_k(qi, block_q: int, block_k: int, window: int):
+    """First k-block index that intersects the sliding-window band of q
+    block ``qi``: floor((qi*BQ - (W-1)) / BK), clamped to 0."""
+    return jnp.maximum((qi * block_q - (window - 1)) // block_k, 0)
+
+
+def _band_mask(s, row, col, causal: bool, window):
+    """Apply causal and/or sliding-window masking to a score block."""
+    if causal:
+        valid = row >= col
+        if window is not None:
+            valid = valid & (row - col < window)
+        s = jnp.where(valid, s, _NEG_INF)
+    return s
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, *rest, nk: int, causal: bool,
-                scale: float, has_seg: bool):
+                scale: float, has_seg: bool, has_alibi: bool = False,
+                window=None):
+    idx = 0
     if has_seg:
         sq_ref, sk_ref = rest[0], rest[1]
-        o_ref, lse_ref, acc_ref, m_ref, l_ref = rest[2:]
+        idx = 2
     else:
         sq_ref = sk_ref = None
-        o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
+    if has_alibi:
+        slope_ref = rest[idx]
+        idx += 1
+    else:
+        slope_ref = None
+    o_ref, lse_ref, acc_ref, m_ref, l_ref = rest[idx:]
     # grid (BH, nq, nk), k innermost ("arbitrary"): Mosaic pipelines the
     # K/V HBM→VMEM copies against compute; the online-softmax carry lives
     # in VMEM scratch across k steps.  q/o blocks: [1, BQ, D]; k/v block:
@@ -106,8 +129,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, nk: int, causal: bool,
 
     # causal: k blocks strictly above the diagonal contribute nothing —
     # skip compute entirely (their DMA was also elided by the clamped
-    # index map in _flash_forward)
+    # index map in _flash_forward); sliding window additionally prunes
+    # blocks entirely left of the band
     compute = (j * block_k <= qi * block_q + block_q - 1) if causal else True
+    if window is not None:
+        compute = compute & (
+            j * block_k + block_k - 1 >= qi * block_q - (window - 1))
 
     @pl.when(compute)
     def _step():
@@ -118,12 +145,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, nk: int, causal: bool,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # [BQ, BK] fp32
-        if causal:
+        if causal or has_alibi:
             row = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             col = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(row >= col, s, _NEG_INF)
+            if has_alibi:
+                # ALiBi: slope_h * (j - i), 0 on the diagonal, more
+                # negative with distance — computed in-kernel, no bias
+                # tensor ever exists in HBM
+                s = s + slope_ref[0, 0, 0] * (col - row).astype(jnp.float32)
+            s = _band_mask(s, row, col, causal, window)
         if has_seg:
             s = _seg_mask(sq_ref, sk_ref, s)
         m = m_ref[...]
@@ -155,11 +187,26 @@ def _gqa_group(q, k):
     return H, Hkv, H // Hkv
 
 
+def _check_band_args(causal, window, alibi_slopes, H):
+    if window is not None:
+        if not causal:
+            raise ValueError("sliding window requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+    if alibi_slopes is not None:
+        if not causal:
+            raise ValueError("alibi_slopes requires causal=True")
+        if alibi_slopes.shape != (H,):
+            raise ValueError(
+                f"alibi_slopes must be [H]={H}, got {alibi_slopes.shape}")
+
+
 def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
-                   segment_ids=None):
+                   segment_ids=None, window=None, alibi_slopes=None):
     interpret = _resolve_interpret(interpret)
     B, T, H, D = q.shape
     H, Hkv, group = _gqa_group(q, k)
+    _check_band_args(causal, window, alibi_slopes, H)
     bq = _fit_block(block_q, T)
     bk = _fit_block(block_k, T)
     nk = T // bk
@@ -172,13 +219,20 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
         return (b // H) * Hkv + (b % H) // group
 
     if causal:
-        # clamp skipped above-diagonal blocks to the last useful index:
-        # consecutive grid steps with an unchanged index skip the DMA
+        # clamp skipped blocks into the useful range: consecutive grid
+        # steps with an unchanged index skip the DMA (above-diagonal
+        # blocks clamp down; left-of-window blocks clamp up)
+        def clamp_j(i, j):
+            jj = jnp.minimum(j, _causal_last_k(i, bq, bk, nk))
+            if window is not None:
+                jj = jnp.maximum(jj, _window_first_k(i, bq, bk, window))
+            return jj
+
         def kv_idx(b, i, j):
-            return (kv_row(b), jnp.minimum(j, _causal_last_k(i, bq, bk, nk)), 0)
+            return (kv_row(b), clamp_j(i, j), 0)
 
         def sk_idx(b, i, j):
-            return (b // H, jnp.minimum(j, _causal_last_k(i, bq, bk, nk)), 0)
+            return (b // H, clamp_j(i, j), 0)
     else:
         def kv_idx(b, i, j):
             return (kv_row(b), j, 0)
@@ -199,10 +253,16 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
             pl.BlockSpec((1, bk, 1), sk_idx),
         ]
         operands += [seg, seg]
+    if alibi_slopes is not None:
+        slopes_f = jnp.tile(alibi_slopes.astype(jnp.float32),
+                            B)[:, None, None]            # [B*H, 1, 1]
+        in_specs += [pl.BlockSpec((1, 1, 1), lambda b, i, j: (b, 0, 0))]
+        operands += [slopes_f]
 
     kernel = functools.partial(
         _fwd_kernel, nk=nk, causal=causal, scale=scale,
-        has_seg=segment_ids is not None)
+        has_seg=segment_ids is not None,
+        has_alibi=alibi_slopes is not None, window=window)
     o, lse = pl.pallas_call(
         kernel,
         grid=(B * H, T // bq, nk),
@@ -230,7 +290,7 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
     return o.reshape(B, H, T, D).transpose(0, 2, 1, 3), lse[..., 0]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 9))
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -241,6 +301,8 @@ def flash_attention(
     block_k: int = DEFAULT_BLOCK_K,
     interpret: Optional[bool] = None,
     segment_ids: Optional[jax.Array] = None,
+    window: Optional[int] = None,
+    alibi_slopes: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Exact attention, O(T) memory forward.  q: ``[B, T, H, D]``;
     k/v: ``[B, T, Hkv, D]`` with ``H % Hkv == 0`` (GQA/MQA: each group of
@@ -252,31 +314,51 @@ def flash_attention(
     an HF-style padding mask works as-is (1 = valid, 0 = pad: pads only
     see pads, so valid positions match the masked-softmax result exactly,
     see models/bert.py).  Every query position shares its own segment id
-    at the diagonal, so no row is ever fully masked."""
+    at the diagonal, so no row is ever fully masked.
+
+    ``window`` (int, optional; requires ``causal=True``) restricts each
+    query to the last ``window`` positions (Mistral-style sliding-window
+    attention): position i attends to j in [i-window+1, i].  Blocks
+    entirely outside the band skip both compute and DMA (the index map
+    clamps from both sides), so the effective cost is O(T * window).
+
+    ``alibi_slopes`` (``[H]`` fp32, optional; requires ``causal=True``)
+    adds the ALiBi position bias ``slope_h * (j - i)`` to the scores —
+    computed from iotas inside the kernel, so no [T, T] bias tensor ever
+    exists.  Slopes are treated as constants (zero cotangent): ALiBi
+    slopes are fixed by the head-count formula in practice, not learned."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     o, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                          interpret, segment_ids)
+                          interpret, segment_ids, window, alibi_slopes)
     return o
 
 
 def _fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret,
-              segment_ids=None):
+              segment_ids, window, alibi_slopes):
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     o, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                            interpret, segment_ids)
-    return o, (q, k, v, o, lse, segment_ids)
+                            interpret, segment_ids, window, alibi_slopes)
+    return o, (q, k, v, o, lse, segment_ids, alibi_slopes)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-                   nk: int, causal: bool, scale: float, has_seg: bool):
+                   nk: int, causal: bool, scale: float, has_seg: bool,
+                   has_alibi: bool = False, window=None):
     """dq accumulation over the k-block grid dim (innermost): recompute
     the [BQ, BK] score slice, accumulate dq = scale * sum_j ds_j @ k_j in
     VMEM scratch; same 3-D-grid pipelining as the forward."""
+    idx = 0
     if has_seg:
-        sq_ref, sk_ref, dq_ref, dq_acc_ref = rest
+        sq_ref, sk_ref = rest[0], rest[1]
+        idx = 2
     else:
         sq_ref = sk_ref = None
-        dq_ref, dq_acc_ref = rest
+    if has_alibi:
+        slope_ref = rest[idx]
+        idx += 1
+    else:
+        slope_ref = None
+    dq_ref, dq_acc_ref = rest[idx:]
     qi = pl.program_id(1)
     j = pl.program_id(2)
     block_q = q_ref.shape[1]
@@ -287,6 +369,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
 
     compute = (j * block_k <= qi * block_q + block_q - 1) if causal else True
+    if window is not None:
+        compute = compute & (
+            j * block_k + block_k - 1 >= qi * block_q - (window - 1))
 
     @pl.when(compute)
     def _step():
@@ -300,12 +385,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # [BQ, BK] fp32
-        if causal:
+        if causal or has_alibi:
             row = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             col = j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(row >= col, s, _NEG_INF)
+            if has_alibi:
+                s = s + slope_ref[0, 0, 0] * (col - row).astype(jnp.float32)
+            s = _band_mask(s, row, col, causal, window)
         if has_seg:
             s = _seg_mask(sq_ref, sk_ref, s)
         p = jnp.exp(s - lse)
@@ -325,15 +412,23 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
 
 def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, *rest,
-                    nq: int, causal: bool, scale: float, has_seg: bool):
+                    nq: int, causal: bool, scale: float, has_seg: bool,
+                    has_alibi: bool = False, window=None):
     """dk/dv accumulation over the q-block grid dim (innermost; causal
     pruning skips q blocks above the diagonal): dv = sum_i p_i^T @ do_i,
     dk = scale * sum_i ds_i^T @ q_i, accumulated in VMEM scratch."""
+    idx = 0
     if has_seg:
-        sk_ref, sq_ref, dk_ref, dv_ref, dk_acc_ref, dv_acc_ref = rest
+        sk_ref, sq_ref = rest[0], rest[1]
+        idx = 2
     else:
         sq_ref = sk_ref = None
-        dk_ref, dv_ref, dk_acc_ref, dv_acc_ref = rest
+    if has_alibi:
+        slope_ref = rest[idx]
+        idx += 1
+    else:
+        slope_ref = None
+    dk_ref, dv_ref, dk_acc_ref, dv_acc_ref = rest[idx:]
     ki = pl.program_id(1)
     i = pl.program_id(2)
     block_k = k_ref.shape[1]
@@ -344,8 +439,13 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, *rest,
         dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
 
-    # causal: q blocks entirely above the diagonal see only masked scores
+    # causal: q blocks entirely above the diagonal see only masked
+    # scores; sliding window additionally prunes q blocks entirely
+    # below/right of the band
     compute = (i * block_q + block_q - 1 >= ki * block_k) if causal else True
+    if window is not None:
+        compute = compute & (
+            i * block_q <= ki * block_k + block_k - 1 + (window - 1))
 
     @pl.when(compute)
     def _step():
@@ -359,12 +459,14 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, *rest,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # [BQ, BK] fp32
-        if causal:
+        if causal or has_alibi:
             row = i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             col = ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(row >= col, s, _NEG_INF)
+            if has_alibi:
+                s = s + slope_ref[0, 0, 0] * (col - row).astype(jnp.float32)
+            s = _band_mask(s, row, col, causal, window)
         if has_seg:
             s = _seg_mask(sq_ref, sk_ref, s)
         p = jnp.exp(s - lse)                       # [BQ, BK] fp32
@@ -390,7 +492,8 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, *rest,
 
 
 def _flash_backward(q, k, v, o, lse, do, dlse, causal, scale, block_q,
-                    block_k, interpret, segment_ids=None):
+                    block_k, interpret, segment_ids=None, window=None,
+                    alibi_slopes=None):
     """Shared Pallas backward.  ``dlse`` (``[BH, T, 1]`` or None) is the
     cotangent of the log-sum-exp output: since d(lse)/d(s) = softmax(s),
     it folds into the kernels as ``ds = p * (dp - (delta - dlse))`` — the
@@ -404,6 +507,7 @@ def _flash_backward(q, k, v, o, lse, do, dlse, causal, scale, block_q,
     interpret = _resolve_interpret(interpret)
     B, T, H, D = q.shape
     H, Hkv, group = _gqa_group(q, k)
+    _check_band_args(causal, window, alibi_slopes, H)
     if group > 1:
         k = jnp.repeat(k, group, axis=2)
         v = jnp.repeat(v, group, axis=2)
@@ -430,10 +534,19 @@ def _flash_backward(q, k, v, o, lse, do, dlse, causal, scale, block_q,
 
     if causal:
         def kv_idx(b, i, j):
-            return (b, jnp.minimum(j, _causal_last_k(i, bq, bk, nk)), 0)
+            jj = jnp.minimum(j, _causal_last_k(i, bq, bk, nk))
+            if window is not None:
+                jj = jnp.maximum(jj, _window_first_k(i, bq, bk, window))
+            return (b, jj, 0)
 
         def q_idx(b, ki, i):  # clamp from below: first useful q block
-            return (b, jnp.maximum(i, (ki * bk) // bq), 0)
+            ii = jnp.maximum(i, (ki * bk) // bq)
+            if window is not None:
+                # clamp from above: last q block inside the band
+                ii = jnp.minimum(
+                    ii, jnp.minimum(
+                        (ki * bk + bk - 1 + window - 1) // bq, nq - 1))
+            return (b, ii, 0)
     else:
         def kv_idx(b, i, j):
             return (b, j, 0)
@@ -442,8 +555,12 @@ def _flash_backward(q, k, v, o, lse, do, dlse, causal, scale, block_q,
             return (b, i, 0)
 
     has_seg = segment_ids is not None
+    has_alibi = alibi_slopes is not None
     if has_seg:
         seg = segment_ids.astype(jnp.int32)[..., None]   # [B, T, 1]
+    if has_alibi:
+        slopes_f = jnp.tile(alibi_slopes.astype(jnp.float32),
+                            B)[:, None, None]            # [B*H, 1, 1]
 
     dq_specs = [
         pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),  # q block
@@ -464,10 +581,14 @@ def _flash_backward(q, k, v, o, lse, do, dlse, causal, scale, block_q,
             pl.BlockSpec((1, bk, 1), skv_idx),
         ]
         dq_ops += [seg, seg]
+    if has_alibi:
+        dq_specs += [pl.BlockSpec((1, 1, 1), lambda b, i, j: (b, 0, 0))]
+        dq_ops += [slopes_f]
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, nk=nk, causal=causal, scale=scale,
-                          has_seg=has_seg),
+                          has_seg=has_seg, has_alibi=has_alibi,
+                          window=window),
         grid=(B * H, nq, nk),
         in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
@@ -496,10 +617,14 @@ def _flash_backward(q, k, v, o, lse, do, dlse, causal, scale, block_q,
             pl.BlockSpec((1, bq, 1), sq_idx),
         ]
         dkv_ops += [seg, seg]
+    if has_alibi:
+        dkv_specs += [pl.BlockSpec((1, 1, 1), lambda b, ki, i: (b, 0, 0))]
+        dkv_ops += [slopes_f]
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, nq=nq, causal=causal, scale=scale,
-                          has_seg=has_seg),
+                          has_seg=has_seg, has_alibi=has_alibi,
+                          window=window),
         grid=(B * H, nk, nq),
         in_specs=dkv_specs,
         out_specs=[
@@ -530,15 +655,18 @@ def _flash_backward(q, k, v, o, lse, do, dlse, causal, scale, block_q,
     return dq_out, dk_out, dv_out
 
 
-def _bwd_rule(causal, scale, block_q, block_k, interpret, res, do):
+def _bwd_rule(causal, scale, block_q, block_k, interpret, window, res, do):
     import numpy as np
 
-    q, k, v, o, lse, segment_ids = res
+    q, k, v, o, lse, segment_ids, alibi_slopes = res
     dq, dk, dv = _flash_backward(q, k, v, o, lse, do, None, causal, scale,
-                                 block_q, block_k, interpret, segment_ids)
+                                 block_q, block_k, interpret, segment_ids,
+                                 window, alibi_slopes)
     dseg = (None if segment_ids is None
             else np.zeros(segment_ids.shape, jax.dtypes.float0))
-    return dq, dk, dv, dseg
+    # slopes are constants by contract (see flash_attention docstring)
+    dslopes = None if alibi_slopes is None else jnp.zeros_like(alibi_slopes)
+    return dq, dk, dv, dseg, dslopes
 
 
 flash_attention.defvjp(_fwd_rule, _bwd_rule)

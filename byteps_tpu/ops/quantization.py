@@ -44,6 +44,25 @@ class EFState(NamedTuple):
     error: Any  # pytree of residuals, same structure as grads
 
 
+def map_ef_pairs(fn, updates, error):
+    """Apply ``fn(g, e) -> (new_g, new_e)`` leafwise over a gradient pytree
+    and its matching error-residual pytree, returning the two result trees.
+
+    Flattens/unflattens rather than tree_mapping with ``is_leaf=tuple``,
+    which would mis-treat tuple-structured gradient pytrees as pairs.
+    Shared by the int8-EF and top-k-EF transformations.
+    """
+    g_flat, treedef = jax.tree_util.tree_flatten(updates)
+    e_flat = jax.tree_util.tree_leaves(error)
+    if len(e_flat) != len(g_flat):
+        raise ValueError(
+            f"gradient/error pytree mismatch: {len(g_flat)} vs {len(e_flat)}"
+            " leaves — was the optimizer state initialized for these params?")
+    outs = [fn(g, e) for g, e in zip(g_flat, e_flat)]
+    return (jax.tree_util.tree_unflatten(treedef, [o[0] for o in outs]),
+            jax.tree_util.tree_unflatten(treedef, [o[1] for o in outs]))
+
+
 def error_feedback_quantize_gradients() -> optax.GradientTransformation:
     """Optax transformation: quantize incoming gradients to int8 (through a
     dequantized fp payload) with error feedback.
@@ -71,11 +90,7 @@ def error_feedback_quantize_gradients() -> optax.GradientTransformation:
             new_e = corrected - deq
             return deq.astype(g.dtype), new_e
 
-        pairs = jax.tree_util.tree_map(q1, updates, state.error)
-        new_updates = jax.tree_util.tree_map(
-            lambda pr: pr[0], pairs, is_leaf=lambda x: isinstance(x, tuple))
-        new_error = jax.tree_util.tree_map(
-            lambda pr: pr[1], pairs, is_leaf=lambda x: isinstance(x, tuple))
+        new_updates, new_error = map_ef_pairs(q1, updates, state.error)
         return new_updates, EFState(error=new_error)
 
     return optax.GradientTransformation(init_fn, update_fn)

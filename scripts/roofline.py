@@ -100,7 +100,7 @@ def main():
         a = jax.random.normal(jax.random.PRNGKey(2), (rows, k), jnp.bfloat16)
         w = jax.random.normal(jax.random.PRNGKey(3), (k, c_out), jnp.bfloat16)
 
-        def conv_step(c, rows=rows, k=k, c_out=c_out):
+        def conv_step(c):
             a, w = c
             out = a @ w
             w = w + out[:1, :] * jnp.asarray(1e-8, jnp.bfloat16)
