@@ -1,0 +1,147 @@
+"""Autoregressive generation with a KV cache.
+
+The reference is a training-communication library and ships no inference
+path; a complete framework needs one.  TPU-first design:
+
+* the KV cache is an explicit functional pytree (``models.transformer.
+  init_cache``) threaded through ``lax.scan`` — not mutable module state —
+  so the whole generation loop is one compiled XLA program;
+* prefill and per-token decode share one static-shape program shape
+  ("tq tokens at offset pos"), so a full generate compiles exactly two
+  programs (prefill tq=T, decode tq=1) regardless of sequence length;
+* sampling (temperature / top-k / top-p) runs on device inside the scan;
+  EOS handling is a carried ``done`` mask (static shapes — finished rows
+  emit ``pad_id`` for the remaining steps).
+
+Typical use::
+
+    fn = make_generate_fn(model, max_new_tokens=64, temperature=0.8,
+                          top_p=0.9, eos_id=2)
+    out = fn(variables, prompt_tokens, jax.random.PRNGKey(0))
+    # out["tokens"]: [B, max_new_tokens]
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .models.transformer import Transformer, init_cache
+
+__all__ = ["make_generate_fn", "generate", "sample_logits"]
+
+
+def sample_logits(logits, rng, temperature: float = 1.0,
+                  top_k: Optional[int] = None,
+                  top_p: Optional[float] = None):
+    """Sample token ids from ``logits [B, vocab]``.
+
+    ``temperature == 0`` is greedy argmax.  ``top_k`` keeps the k highest
+    logits; ``top_p`` keeps the smallest prefix of the sorted distribution
+    with cumulative probability >= top_p (the highest-probability token is
+    always kept).  Both filters compose (k first, then p), matching the
+    usual HF ``generate`` semantics.
+    """
+    if temperature == 0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k is not None and top_k < logits.shape[-1]:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None and top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens whose *exclusive* cumulative mass is < top_p; the
+        # argmax token has exclusive mass 0 and so always survives
+        keep_sorted = (cum - probs) < top_p
+        # threshold = smallest kept logit, mapped back to original order
+        kept_logits = jnp.where(keep_sorted, sorted_logits, jnp.inf)
+        threshold = jnp.min(kept_logits, axis=-1, keepdims=True)
+        logits = jnp.where(logits < threshold, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
+def make_generate_fn(model: Transformer, max_new_tokens: int, *,
+                     temperature: float = 1.0,
+                     top_k: Optional[int] = None,
+                     top_p: Optional[float] = None,
+                     eos_id: Optional[int] = None,
+                     pad_id: int = 0):
+    """Build a jitted ``fn(variables, prompt [B, T], rng) -> dict`` that
+    appends ``max_new_tokens`` sampled tokens to each prompt row.
+
+    The prompt must be fully valid (no padding); rows that emit ``eos_id``
+    are frozen to ``pad_id`` for the remaining steps.  Returns
+    ``{"tokens": [B, max_new_tokens], "done": [B] bool}``.
+    """
+    cfg = model.cfg
+
+    def run(variables, prompt, rng):
+        B, T = prompt.shape
+        caches = init_cache(cfg, B, T + max_new_tokens)
+        # prefill: one batched forward writes the prompt's K/V into the
+        # cache; last_only keeps the LM head off the T-1 positions whose
+        # [B, T, vocab] fp32 logits nobody reads
+        logits, caches = model.apply(
+            variables, prompt, caches, 0, True, method=Transformer.decode)
+        rng, sub = jax.random.split(rng)
+        tok = sample_logits(logits[:, -1], sub, temperature, top_k, top_p)
+        done = (tok == eos_id) if eos_id is not None else jnp.zeros(B, bool)
+
+        def step(carry, i):
+            caches, tok, done, rng = carry
+            logits, caches = model.apply(
+                variables, tok[:, None], caches, T + i,
+                method=Transformer.decode)
+            rng, sub = jax.random.split(rng)
+            nxt = sample_logits(
+                logits[:, -1], sub, temperature, top_k, top_p)
+            nxt = jnp.where(done, pad_id, nxt)
+            if eos_id is not None:
+                done = done | (nxt == eos_id)
+            return (caches, nxt, done, rng), tok
+
+        (caches, tok, done, rng), toks = jax.lax.scan(
+            step, (caches, tok, done, rng),
+            jnp.arange(max_new_tokens - 1))
+        del caches
+        tokens = jnp.concatenate(
+            [jnp.moveaxis(toks, 0, 1), tok[:, None]], axis=1)
+        return {"tokens": tokens, "done": done}
+
+    return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=32)
+def _cached_fn(model, max_new_tokens, temperature, top_k, top_p, eos_id,
+               pad_id):
+    return make_generate_fn(
+        model, max_new_tokens, temperature=temperature, top_k=top_k,
+        top_p=top_p, eos_id=eos_id, pad_id=pad_id)
+
+
+def generate(model: Transformer, variables, prompt, max_new_tokens: int, *,
+             temperature: float = 1.0, top_k: Optional[int] = None,
+             top_p: Optional[float] = None, eos_id: Optional[int] = None,
+             pad_id: int = 0, rng=None):
+    """Convenience wrapper around :func:`make_generate_fn` (memoized on the
+    static arguments, so repeated calls reuse the compiled program).
+
+    Stochastic sampling (``temperature > 0``) requires an explicit ``rng``
+    — a silent default key would make every call return the identical
+    "sample".  Greedy decoding (``temperature=0``) needs no rng.
+    """
+    if rng is None:
+        if temperature != 0:
+            raise ValueError(
+                "temperature > 0 samples stochastically: pass rng="
+                "jax.random.PRNGKey(...) (each distinct key gives a "
+                "distinct sample)")
+        rng = jax.random.PRNGKey(0)
+    fn = _cached_fn(model, max_new_tokens, temperature, top_k, top_p,
+                    eos_id, pad_id)
+    return fn(variables, prompt, rng)

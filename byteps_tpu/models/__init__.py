@@ -9,7 +9,7 @@ Python control flow under jit.
 
 from .resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101, ResNet152
 from .vgg import VGG, VGG11, VGG16, VGG19
-from .transformer import Transformer, TransformerConfig
+from .transformer import Transformer, TransformerConfig, init_cache
 from .bert import BertClassifier, BertEncoder, BertMLM, bert_config
 from .mobilenet import MobileNetV2
 from .classic import AlexNet, LeNet
@@ -17,7 +17,7 @@ from .classic import AlexNet, LeNet
 __all__ = [
     "ResNet", "ResNet18", "ResNet34", "ResNet50", "ResNet101", "ResNet152",
     "VGG", "VGG11", "VGG16", "VGG19",
-    "Transformer", "TransformerConfig",
+    "Transformer", "TransformerConfig", "init_cache",
     "BertEncoder", "BertClassifier", "BertMLM", "bert_config",
     "MobileNetV2", "AlexNet", "LeNet",
 ]

@@ -115,11 +115,37 @@ class TransformerConfig:
         )
 
 
+def _cached_attention(q, ck, cv, pos, window=None):
+    """Dense attention of ``q [B, tq, H, D]`` (absolute offset ``pos``)
+    against a KV cache ``ck/cv [B, S, H, D]`` whose slots beyond
+    ``pos + tq`` are unwritten.
+
+    The causal mask ``key_j <= pos + i`` both enforces autoregressive
+    order and excludes the unwritten tail, so one static-shape program
+    serves prefill (tq = prompt length, pos = 0) and decode (tq = 1)
+    alike — no dynamic shapes, no recompilation per step.  O(S) dense
+    scores are the right call here: decode is HBM-bound on the cache
+    read anyway, and tq is tiny.
+    """
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q * scale, ck,
+        preferred_element_type=jnp.float32)
+    kidx = jnp.arange(ck.shape[1])[None, None, None, :]
+    qidx = (pos + jnp.arange(q.shape[1]))[None, None, :, None]
+    mask = kidx <= qidx
+    if window is not None:
+        mask = mask & (kidx > qidx - window)
+    scores = jnp.where(mask, scores, jnp.float32(-1e30))
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, cv)
+
+
 class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, key_mask=None):
+    def __call__(self, x, key_mask=None, cache=None, pos=None):
         cfg = self.cfg
         H, D = cfg.num_heads, cfg.d_model // cfg.num_heads
         proj = partial(
@@ -131,6 +157,30 @@ class Attention(nn.Module):
         q = proj(features=(H, D), name="q")(x)
         k = proj(features=(H, D), name="k")(x)
         v = proj(features=(H, D), name="v")(x)
+        o_proj = nn.DenseGeneral(
+            features=cfg.d_model, axis=(-2, -1), dtype=cfg.dtype,
+            use_bias=False, name="o",
+            kernel_init=cfg.partition(
+                nn.initializers.xavier_uniform(), (cfg.tp_axis, None, None)
+            ),
+        )
+        if cache is not None:
+            # autoregressive decode/prefill against an explicit KV cache
+            # (a functional pytree the caller threads through lax.scan —
+            # not flax mutable state, so the whole loop jits cleanly)
+            if not cfg.causal:
+                raise ValueError("KV-cache decode requires causal=True")
+            if key_mask is not None:
+                raise ValueError(
+                    "KV-cache decode does not support key_mask: pad "
+                    "tokens' K/V would enter the cache as real context. "
+                    "Strip padding from the prompt before generate().")
+            ck = jax.lax.dynamic_update_slice(
+                cache["k"], k.astype(cache["k"].dtype), (0, pos, 0, 0))
+            cv = jax.lax.dynamic_update_slice(
+                cache["v"], v.astype(cache["v"].dtype), (0, pos, 0, 0))
+            out = _cached_attention(q, ck, cv, pos, window=cfg.attn_window)
+            return o_proj(out), {"k": ck, "v": cv}
         if key_mask is not None:
             if cfg.attn_impl == "flash" and not cfg.has_sp:
                 # padding mask rides the flash kernel's segment ids (pads
@@ -152,13 +202,7 @@ class Attention(nn.Module):
                                       key_mask=key_mask)
         else:
             out = cfg.attention_fn()(q, k, v)
-        return nn.DenseGeneral(
-            features=cfg.d_model, axis=(-2, -1), dtype=cfg.dtype,
-            use_bias=False, name="o",
-            kernel_init=cfg.partition(
-                nn.initializers.xavier_uniform(), (cfg.tp_axis, None, None)
-            ),
-        )(out)
+        return o_proj(out)
 
 
 class MLP(nn.Module):
@@ -186,11 +230,22 @@ class Block(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, key_mask=None):
+    def __call__(self, x, key_mask=None, cache=None, pos=None):
         y = nn.RMSNorm(dtype=self.cfg.dtype, name="ln1")(x)
-        x = x + Attention(self.cfg, name="attn")(y, key_mask=key_mask)
+        if cache is not None:
+            if key_mask is not None:
+                raise ValueError(
+                    "KV-cache decode does not support key_mask (pad K/V "
+                    "would enter the cache as real context)")
+            attn_out, new_cache = Attention(self.cfg, name="attn")(
+                y, cache=cache, pos=pos)
+            x = x + attn_out
+        else:
+            new_cache = None
+            x = x + Attention(self.cfg, name="attn")(y, key_mask=key_mask)
         y = nn.RMSNorm(dtype=self.cfg.dtype, name="ln2")(x)
-        return x + MLP(self.cfg, name="mlp")(y)
+        x = x + MLP(self.cfg, name="mlp")(y)
+        return (x, new_cache) if cache is not None else x
 
 
 class Transformer(nn.Module):
@@ -236,3 +291,44 @@ class Transformer(nn.Module):
 
     def __call__(self, tokens):
         return self.lm_head(self.hidden(tokens)).astype(jnp.float32)
+
+    def decode(self, tokens, caches, pos, last_only=False):
+        """One autoregressive step over ``tokens [B, tq]`` at absolute
+        offset ``pos`` (traced scalar) against per-layer KV caches.
+
+        Returns ``(logits [B, tq, vocab], new_caches)``.  The same method
+        serves prefill (``tq`` = prompt length, ``pos=0``) and decode
+        (``tq=1``) — static shapes throughout, so a generation loop
+        compiles exactly two programs.  Build caches with ``init_cache``;
+        drive the loop with ``byteps_tpu.inference.generate``.
+
+        ``last_only=True`` applies the LM head to the final position only
+        (logits ``[B, 1, vocab]``) — generation prefill needs just the
+        next-token distribution, and the full ``[B, tq, vocab]`` fp32
+        logits would otherwise dominate prefill HBM at real vocab sizes.
+        """
+        x = self.embed(tokens)
+        x = x + self.pos((pos + jnp.arange(tokens.shape[1]))[None, :])
+        new_caches = []
+        for block, c in zip(self.blocks, caches):
+            x, nc = block(x, cache=c, pos=pos)
+            new_caches.append(nc)
+        if last_only:
+            x = x[:, -1:]
+        logits = self.lm_head(self.ln_f(x)).astype(jnp.float32)
+        return logits, tuple(new_caches)
+
+
+def init_cache(cfg: TransformerConfig, batch_size: int, max_len: int):
+    """Zeroed per-layer KV caches ``[B, max_len, H, D]`` for
+    ``Transformer.decode``.  ``max_len`` must cover prompt + new tokens
+    and stay within ``cfg.max_seq_len`` (position embeddings)."""
+    if max_len > cfg.max_seq_len:
+        raise ValueError(
+            f"cache max_len {max_len} exceeds max_seq_len {cfg.max_seq_len}")
+    H, D = cfg.num_heads, cfg.d_model // cfg.num_heads
+    shape = (batch_size, max_len, H, D)
+    return tuple(
+        {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+        for _ in range(cfg.num_layers)
+    )
