@@ -31,7 +31,64 @@ import jax.numpy as jnp
 
 from .models.transformer import Transformer, init_cache
 
-__all__ = ["make_generate_fn", "generate", "sample_logits"]
+__all__ = ["make_generate_fn", "generate", "sample_logits",
+           "quantize_params"]
+
+
+def quantize_params(params, in_axes_of=None):
+    """Int8 weight-only quantization of a Transformer parameter tree for
+    bandwidth-bound decode.
+
+    Every ``QuantDense`` kernel is replaced by a symmetric per-output-
+    channel int8 kernel plus an fp32 ``scale`` leaf (absmax over the
+    contraction dims / 127); embeddings and norms are left untouched
+    (embeddings are gathered, not streamed, and norms are tiny).  The
+    resulting tree feeds straight into ``model.apply`` / ``generate`` —
+    ``QuantDense`` dequantizes inside the matmul read, so HBM streams
+    half the bytes (see docs/performance.md).
+
+    ``in_axes_of`` maps a module name to its contraction-dim count for
+    non-default layouts; the Transformer only needs ``{"o": 2}`` (the
+    output projection contracts [H, D]), which is the default.
+    """
+    import flax.linen as nn
+
+    in_axes_of = {"o": 2} if in_axes_of is None else in_axes_of
+
+    def walk(node, name):
+        if isinstance(node, dict):
+            kern = node.get("kernel")
+            # tp-sharded trees carry nn.Partitioned metadata boxes —
+            # unbox for the math, re-box so the sharding survives
+            boxed = isinstance(kern, nn.meta.AxisMetadata)
+            w_raw = kern.unbox() if boxed else kern
+            if w_raw is not None and jnp.issubdtype(
+                    jnp.asarray(w_raw).dtype, jnp.floating):
+                w = jnp.asarray(w_raw, jnp.float32)
+                n_in = in_axes_of.get(name, 1)
+                axes = tuple(range(n_in))
+                absmax = jnp.max(jnp.abs(w), axis=axes)
+                scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+                q = jnp.clip(jnp.round(w / scale), -127, 127)
+                out = dict(node)
+                qk = q.astype(jnp.int8)
+                sc = scale.astype(jnp.float32)
+                if boxed:
+                    out["kernel"] = kern.replace_boxed(qk)
+                    # the scale spans the kernel's output dims; carry the
+                    # matching tail of the partition names
+                    names = getattr(kern, "names", None)
+                    if names is not None and any(names[n_in:]):
+                        sc = nn.Partitioned(sc, names=tuple(names[n_in:]))
+                    out["scale"] = sc
+                else:
+                    out["kernel"] = qk
+                    out["scale"] = sc
+                return out
+            return {k: walk(v, k) for k, v in node.items()}
+        return node
+
+    return walk(params, "")
 
 
 def sample_logits(logits, rng, temperature: float = 1.0,

@@ -115,6 +115,62 @@ class TransformerConfig:
         )
 
 
+class QuantDense(nn.Module):
+    """Dense / DenseGeneral replacement that also accepts int8
+    weight-only-quantized parameter trees.
+
+    With an fp tree (``kernel`` float, no ``scale``) it computes exactly
+    what ``nn.Dense``/``nn.DenseGeneral`` compute.  With a quantized tree
+    (``kernel`` int8 + per-output-channel fp32 ``scale``, produced by
+    ``inference.quantize_params``) it dequantizes *inside* the matmul —
+    ``kernel.astype(dtype) * scale`` fuses into the dot's operand read, so
+    HBM streams int8 bytes.  That halves decode's weight traffic, which is
+    the whole cost of bandwidth-bound generation (docs/performance.md).
+    ``init`` never creates ``scale``: quantization is a property of the
+    parameter tree, not the module.
+
+    ``features`` may be an int or tuple; ``in_axes`` is how many trailing
+    input dims contract (1 for Dense/qkv, 2 for the o-projection).
+    """
+
+    features: Any
+    in_axes: int = 1
+    dtype: Any = jnp.float32
+    kernel_init: Any = nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self, x):
+        feats = (self.features if isinstance(self.features, tuple)
+                 else (self.features,))
+        kshape = tuple(x.shape[-self.in_axes:]) + feats
+        kernel = self.param("kernel", self.kernel_init, kshape)
+        if self.has_variable("params", "scale"):
+            scale = self.get_variable("params", "scale")
+            # tie the dequant to the (loop-varying) activation with an
+            # exact zero: without this data dependence XLA's loop-
+            # invariant code motion hoists converted bf16 weight copies
+            # out of the decode scan, doubling weight HBM residency and
+            # defeating the int8 *footprint* win (optimization_barrier
+            # does NOT stop LICM — the barrier chain is itself invariant
+            # and moves out whole).  With the dependence, the compiled
+            # while body carries s8 kernels and fuses dequant into the
+            # dots (verified in optimized HLO).  isfinite-guarded so a
+            # NaN/inf activation cannot poison the scale.  Measured on
+            # the bench chip: no decode *speed* change either way (see
+            # docs/performance.md) — the win is memory, not time.
+            v = x.ravel()[0].astype(jnp.float32)
+            eps = jnp.where(jnp.isfinite(v), v, 0.0) * 0.0
+            w = (kernel.astype(self.dtype)
+                 * (scale + eps).astype(self.dtype))
+        else:
+            w = kernel.astype(self.dtype)
+        y = jax.lax.dot_general(
+            x.astype(self.dtype), w,
+            ((tuple(range(x.ndim - self.in_axes, x.ndim)),
+              tuple(range(self.in_axes))), ((), ())))
+        return y
+
+
 def _cached_attention(q, ck, cv, pos, window=None):
     """Dense attention of ``q [B, tq, H, D]`` (absolute offset ``pos``)
     against a KV cache ``ck/cv [B, S, H, D]`` whose slots beyond
@@ -149,7 +205,7 @@ class Attention(nn.Module):
         cfg = self.cfg
         H, D = cfg.num_heads, cfg.d_model // cfg.num_heads
         proj = partial(
-            nn.DenseGeneral, dtype=cfg.dtype, use_bias=False,
+            QuantDense, dtype=cfg.dtype,
             kernel_init=cfg.partition(
                 nn.initializers.xavier_uniform(), (None, cfg.tp_axis, None)
             ),
@@ -157,9 +213,8 @@ class Attention(nn.Module):
         q = proj(features=(H, D), name="q")(x)
         k = proj(features=(H, D), name="k")(x)
         v = proj(features=(H, D), name="v")(x)
-        o_proj = nn.DenseGeneral(
-            features=cfg.d_model, axis=(-2, -1), dtype=cfg.dtype,
-            use_bias=False, name="o",
+        o_proj = QuantDense(
+            features=cfg.d_model, in_axes=2, dtype=cfg.dtype, name="o",
             kernel_init=cfg.partition(
                 nn.initializers.xavier_uniform(), (cfg.tp_axis, None, None)
             ),
@@ -211,15 +266,15 @@ class MLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        h = nn.Dense(
-            cfg.d_ff, dtype=cfg.dtype, use_bias=False, name="up",
+        h = QuantDense(
+            features=cfg.d_ff, dtype=cfg.dtype, name="up",
             kernel_init=cfg.partition(
                 nn.initializers.xavier_uniform(), (None, cfg.tp_axis)
             ),
         )(x)
         h = nn.gelu(h)
-        return nn.Dense(
-            cfg.d_model, dtype=cfg.dtype, use_bias=False, name="down",
+        return QuantDense(
+            features=cfg.d_model, dtype=cfg.dtype, name="down",
             kernel_init=cfg.partition(
                 nn.initializers.xavier_uniform(), (cfg.tp_axis, None)
             ),
@@ -276,8 +331,8 @@ class Transformer(nn.Module):
             Block(cfg, name=f"block_{i}") for i in range(cfg.num_layers)
         ]
         self.ln_f = nn.RMSNorm(dtype=cfg.dtype, name="ln_f")
-        self.lm_head = nn.Dense(
-            cfg.vocab_size, dtype=jnp.float32, use_bias=False, name="lm_head",
+        self.lm_head = QuantDense(
+            cfg.vocab_size, dtype=jnp.float32, name="lm_head",
         )
 
     def hidden(self, tokens):

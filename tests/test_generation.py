@@ -182,6 +182,25 @@ def test_generate_requires_rng_when_sampling():
     generate(model, variables, tokens, 2, temperature=0)
 
 
+def test_generate_dp_sharded():
+    """Distributed inference: generation with the batch sharded over an
+    8-device dp mesh equals the single-device result — XLA partitions the
+    whole prefill+scan program (cache included) along batch."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    cfg, model, _, _ = _tiny_model()
+    prompt = jax.random.randint(jax.random.PRNGKey(4), (8, 12), 0, 61)
+    variables = model.init(jax.random.PRNGKey(1), prompt)
+    want = generate(model, variables, prompt, 6, temperature=0)
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    sharded = jax.device_put(prompt, NamedSharding(mesh, P("dp", None)))
+    repl = jax.device_put(variables, NamedSharding(mesh, P()))
+    got = generate(model, repl, sharded, 6, temperature=0)
+    np.testing.assert_array_equal(
+        np.asarray(got["tokens"]), np.asarray(want["tokens"]))
+
+
 def test_cache_len_guard():
     cfg, model, tokens, variables = _tiny_model()
     with pytest.raises(ValueError):
