@@ -32,7 +32,7 @@ import jax.numpy as jnp
 from .models.transformer import Transformer, init_cache
 
 __all__ = ["make_generate_fn", "generate", "sample_logits",
-           "quantize_params"]
+           "quantize_params", "beam_search"]
 
 
 def quantize_params(params, in_axes_of=None):
@@ -202,3 +202,110 @@ def generate(model: Transformer, variables, prompt, max_new_tokens: int, *,
     fn = _cached_fn(model, max_new_tokens, temperature, top_k, top_p,
                     eos_id, pad_id)
     return fn(variables, prompt, rng)
+
+
+def beam_search(model: Transformer, variables, prompt, max_new_tokens: int,
+                num_beams: int, *, length_penalty: float = 1.0,
+                eos_id: Optional[int] = None, pad_id: int = 0):
+    """Beam-search decoding with the KV cache: returns the highest-scoring
+    continuation per batch row.
+
+    At each step every live beam expands over the full vocabulary, the
+    top ``num_beams`` (by cumulative log-probability) survive per batch
+    row, and their KV caches are gathered to follow the surviving
+    parents — the cache reorder is a batched ``take`` on the cache
+    pytree inside the scan, so the whole search is one compiled program
+    (without ``eos_id`` this is exact beam search; the brute-force
+    reference test pins it).  EOS semantics are the *frozen-slot*
+    variant: a beam that emits ``eos_id`` keeps its slot, emitting
+    ``pad_id`` at zero additional cost and a frozen length — unlike HF,
+    which retires finished hypotheses to a pool and promotes the
+    next-best live candidate into the freed slot, so with ``eos_id`` set
+    the effective exploration width shrinks as beams finish.  Final
+    ranking divides each beam's score by ``length**length_penalty``
+    (>1 favors longer sequences).
+
+    Returns ``{"tokens": [B, max_new_tokens], "scores": [B],
+    "beam_tokens": [B, num_beams, max_new_tokens],
+    "beam_scores": [B, num_beams]}`` — tokens/scores are the best beam's.
+    """
+    fn = _cached_beam_fn(model, max_new_tokens, num_beams,
+                         length_penalty, eos_id, pad_id)
+    return fn(variables, prompt)
+
+
+@functools.lru_cache(maxsize=32)
+def _cached_beam_fn(model, max_new_tokens, num_beams, length_penalty,
+                    eos_id, pad_id):
+    cfg = model.cfg
+    K = num_beams
+    V = cfg.vocab_size
+    N = max_new_tokens
+    NEG = jnp.float32(-1e30)
+
+    def run(variables, prompt):
+        B, T = prompt.shape
+        caches = init_cache(cfg, B, T + N)
+        logits, caches = model.apply(
+            variables, prompt, caches, 0, True, method=Transformer.decode)
+        logprobs = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))
+        # distinct first tokens seed the beams
+        scores, tok0 = jax.lax.top_k(logprobs, K)        # [B, K]
+        # caches tile to [B*K, ...] — beam-major within each batch row
+        caches = jax.tree_util.tree_map(
+            lambda c: jnp.repeat(c, K, axis=0), caches)
+        flat_tok = tok0.reshape(B * K)
+        done = ((flat_tok == eos_id) if eos_id is not None
+                else jnp.zeros(B * K, bool))
+        lengths = jnp.ones(B * K, jnp.int32)             # tokens emitted
+        history = jnp.full((B * K, N), pad_id, jnp.int32)
+        history = history.at[:, 0].set(flat_tok)
+        scores = scores.reshape(B * K)
+
+        def step(carry, i):
+            caches, tok, scores, done, lengths, history = carry
+            logits, caches = model.apply(
+                variables, tok[:, None], caches, T + i,
+                method=Transformer.decode)
+            lp = jax.nn.log_softmax(
+                logits[:, -1].astype(jnp.float32))       # [B*K, V]
+            # finished beams: only pad continues, at zero cost
+            pad_row = jnp.full((V,), NEG).at[pad_id].set(0.0)
+            lp = jnp.where(done[:, None], pad_row[None, :], lp)
+            cand = scores[:, None] + lp                  # [B*K, V]
+            cand = cand.reshape(B, K * V)
+            new_scores, idx = jax.lax.top_k(cand, K)     # [B, K]
+            parent = idx // V                            # beam within row
+            new_tok = idx % V                            # token id
+            flat_parent = (jnp.arange(B)[:, None] * K + parent).reshape(-1)
+            # follow the surviving parents
+            caches = jax.tree_util.tree_map(
+                lambda c: jnp.take(c, flat_parent, axis=0), caches)
+            done = jnp.take(done, flat_parent)
+            lengths = jnp.take(lengths, flat_parent)
+            history = jnp.take(history, flat_parent, axis=0)
+            flat_tok = new_tok.reshape(B * K)
+            flat_tok = jnp.where(done, pad_id, flat_tok)
+            history = history.at[:, i + 1].set(flat_tok)
+            lengths = jnp.where(done, lengths, lengths + 1)
+            if eos_id is not None:
+                done = done | (flat_tok == eos_id)
+            return (caches, flat_tok, new_scores.reshape(B * K), done,
+                    lengths, history), ()
+
+        (caches, tok, scores, done, lengths, history), _ = jax.lax.scan(
+            step, (caches, flat_tok, scores, done, lengths, history),
+            jnp.arange(N - 1))
+        del caches
+        # rank by length-normalized score
+        norm = scores / (lengths.astype(jnp.float32) ** length_penalty)
+        norm = norm.reshape(B, K)
+        best = jnp.argmax(norm, axis=-1)                 # [B]
+        history = history.reshape(B, K, N)
+        best_tokens = jnp.take_along_axis(
+            history, best[:, None, None], axis=1)[:, 0]
+        best_scores = jnp.take_along_axis(norm, best[:, None], axis=1)[:, 0]
+        return {"tokens": best_tokens, "scores": best_scores,
+                "beam_tokens": history, "beam_scores": norm}
+
+    return jax.jit(run)
