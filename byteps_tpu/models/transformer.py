@@ -234,7 +234,26 @@ class Attention(nn.Module):
                 cache["k"], k.astype(cache["k"].dtype), (0, pos, 0, 0))
             cv = jax.lax.dynamic_update_slice(
                 cache["v"], v.astype(cache["v"].dtype), (0, pos, 0, 0))
-            out = _cached_attention(q, ck, cv, pos, window=cfg.attn_window)
+            import math as _math
+
+            if (isinstance(pos, int) and pos == 0 and x.shape[1] > 1
+                    and cfg.attn_impl == "flash" and not cfg.has_sp
+                    and _math.gcd(x.shape[1], 1024) >= 128):
+                # prefill fast path: at a *static* pos=0 the valid keys are
+                # exactly the q/k/v just computed, so the causal Pallas
+                # kernel serves prefill directly — O(T) memory instead of
+                # the dense [T, S] score matrix, and the same kernel the
+                # model trains with (1.96x at T=2048).  The gcd gate keeps
+                # awkward prompt lengths (tiny, or T>1024 coprime with the
+                # kernel's block) on the dense path, where the Pallas
+                # block fitter would crash or degrade to slivers.
+                from ..ops.flash_attention import flash_attention
+
+                out = flash_attention(q, k, v, causal=True,
+                                      window=cfg.attn_window)
+            else:
+                out = _cached_attention(q, ck, cv, pos,
+                                        window=cfg.attn_window)
             return o_proj(out), {"k": ck, "v": cv}
         if key_mask is not None:
             if cfg.attn_impl == "flash" and not cfg.has_sp:

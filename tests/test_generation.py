@@ -97,6 +97,39 @@ def test_generate_windowed_flash_model():
         np.asarray(out["tokens"]), np.asarray(jnp.stack(want, axis=1)))
 
 
+def test_flash_prefill_matches_dense_cache_path():
+    """The static pos=0 prefill fast path (Pallas flash kernel) must agree
+    with the dense cached-attention path it replaces."""
+    cfg, model, tokens, variables = _tiny_model(attn_impl="flash")
+    caches = init_cache(cfg, tokens.shape[0], 24)
+    # flash fast path engages for literal pos=0 with tq>1
+    fast, fast_caches = model.apply(
+        variables, tokens, caches, 0, method=Transformer.decode)
+    # traced pos forces the dense path on identical math
+    dense, dense_caches = jax.jit(
+        lambda v, t, c, p: model.apply(v, t, c, p,
+                                       method=Transformer.decode)
+    )(variables, tokens, caches, jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(fast), np.asarray(dense),
+                               rtol=2e-4, atol=2e-4)
+    for fc, dc in zip(fast_caches, dense_caches):
+        np.testing.assert_allclose(np.asarray(fc["k"]), np.asarray(dc["k"]),
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_flash_prefill_awkward_lengths_fall_back():
+    """Prompt lengths the Pallas block fitter can't serve (tiny, or odd
+    T>1024) must route to the dense cache path, not crash (regression:
+    T=4 raised ValueError from fit_block)."""
+    cfg, model, _, _ = _tiny_model(attn_impl="flash")
+    init_tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 16), 0, 61)
+    variables = model.init(jax.random.PRNGKey(1), init_tokens)
+    for T in (4, 7):
+        prompt = jax.random.randint(jax.random.PRNGKey(2), (2, T), 0, 61)
+        out = generate(model, variables, prompt, 3, temperature=0)
+        assert out["tokens"].shape == (2, 3)
+
+
 def test_eos_freezes_row():
     cfg, model, tokens, variables = _tiny_model()
     n = 8
