@@ -32,7 +32,7 @@ import jax.numpy as jnp
 from .models.transformer import Transformer, init_cache
 
 __all__ = ["make_generate_fn", "generate", "sample_logits",
-           "quantize_params", "beam_search"]
+           "quantize_params", "beam_search", "speculative_generate"]
 
 
 def quantize_params(params, in_axes_of=None):
@@ -307,5 +307,144 @@ def _cached_beam_fn(model, max_new_tokens, num_beams, length_penalty,
         best_scores = jnp.take_along_axis(norm, best[:, None], axis=1)[:, 0]
         return {"tokens": best_tokens, "scores": best_scores,
                 "beam_tokens": history, "beam_scores": norm}
+
+    return jax.jit(run)
+
+
+def speculative_generate(target: Transformer, target_vars,
+                         draft: Transformer, draft_vars,
+                         prompt, max_new_tokens: int, *, gamma: int = 4,
+                         eos_id: Optional[int] = None, pad_id: int = 0):
+    """Greedy speculative decoding: a small draft model proposes ``gamma``
+    tokens autoregressively, the target model verifies them in ONE
+    ``gamma+1``-token decode, and the longest agreeing prefix is accepted
+    plus the target's own next token — so each target forward emits
+    between 1 and ``gamma+1`` tokens.  In exact arithmetic greedy
+    acceptance makes the output identical to target-only greedy decoding
+    (the draft only changes speed, never content); in floating point the
+    correction token comes from a tq=gamma+1 forward whose reduction
+    order differs from ``generate``'s tq=1 steps, so a near-tie argmax
+    can occasionally flip.  The exactness tests pin equality on fixed
+    seeds.
+
+    The KV-cache design makes rejection rollback free: cache slots beyond
+    ``pos`` are never read (the causal mask doubles as the validity mask),
+    so rejected drafts' K/V are simply overwritten later and both models
+    just track the accepted position.  Both models must share the
+    vocabulary.  Returns ``{"tokens": [B, max_new_tokens],
+    "acceptance": mean accepted-per-round fraction}``.
+    """
+    fn = _cached_spec_fn(target, draft, max_new_tokens, gamma, eos_id,
+                         pad_id)
+    return fn(target_vars, draft_vars, prompt)
+
+
+@functools.lru_cache(maxsize=16)
+def _cached_spec_fn(target, draft, max_new_tokens, gamma, eos_id, pad_id):
+    N, G = max_new_tokens, gamma
+    tcfg, dcfg = target.cfg, draft.cfg
+
+    def run(target_vars, draft_vars, prompt):
+        B, T = prompt.shape
+        S = T + N + G + 1
+        t_caches = init_cache(tcfg, B, S)
+        d_caches = init_cache(dcfg, B, S)
+        # prefill both models; the target's last-position logits give the
+        # first pending token
+        t_logits, t_caches = target.apply(
+            target_vars, prompt, t_caches, 0, True,
+            method=Transformer.decode)
+        _, d_caches = draft.apply(
+            draft_vars, prompt, d_caches, 0, True,
+            method=Transformer.decode)
+        last = jnp.argmax(t_logits[:, -1], axis=-1)      # pending token
+        out = jnp.full((B, N + G + 1), pad_id, jnp.int32)
+        done = ((last == eos_id) if eos_id is not None
+                else jnp.zeros(B, bool))
+        out = out.at[:, 0].set(last)
+
+        # carry: emitted counts the tokens already WRITTEN to out;
+        # pos = T + emitted - 1 is both caches' valid-prefix length
+        # (the newest written token is pending, its K/V not yet stored)
+        def cond(c):
+            return c[0] < N
+
+        def body(c):
+            emitted, last, out, done, t_caches, d_caches, rounds, acc = c
+            pos = T + emitted - 1
+
+            # draft G tokens with the small model
+            def d_step(carry, _):
+                d_caches, tok, p = carry
+                lg, d_caches = draft.apply(
+                    draft_vars, tok[:, None], d_caches, p,
+                    method=Transformer.decode)
+                nxt = jnp.argmax(lg[:, -1], axis=-1)
+                return (d_caches, nxt, p + 1), nxt
+
+            (d_caches, _, _), drafts = jax.lax.scan(
+                d_step, (d_caches, last, pos), None, length=G)
+            drafts = jnp.moveaxis(drafts, 0, 1)          # [B, G]
+
+            # one target forward verifies all G drafts (+ bonus token)
+            block = jnp.concatenate([last[:, None], drafts], axis=1)
+            t_lg, t_caches = target.apply(
+                target_vars, block, t_caches, pos,
+                method=Transformer.decode)
+            t_argmax = jnp.argmax(t_lg, axis=-1)         # [B, G+1]
+
+            # longest agreeing prefix per row
+            agree = (t_argmax[:, :G] == drafts)
+            k = jnp.sum(jnp.cumprod(agree.astype(jnp.int32), axis=1),
+                        axis=1)                          # [B] in [0, G]
+            # lockstep across the batch: accept the batch-min prefix so a
+            # single scalar pos advance serves every row (per-row pos
+            # would need per-row cache offsets); rows that could have
+            # accepted more simply re-verify those tokens next round --
+            # same output, slightly more rounds on divergent batches
+            kmin = jnp.min(jnp.where(done, G, k))
+            take = kmin + 1                              # tokens emitted
+            # emitted block: kmin accepted drafts, then the target's own
+            # argmax at position kmin (correction if kmin<G, bonus at G)
+            corr = jnp.take_along_axis(
+                t_argmax, jnp.full((B, 1), kmin), axis=1)[:, 0]
+            cols = jnp.arange(G + 1)[None, :]
+            toks = jnp.where(cols < kmin[None, None][0],
+                             jnp.concatenate(
+                                 [drafts, drafts[:, :1]], axis=1),
+                             pad_id).astype(jnp.int32)
+            toks = toks.at[:, kmin].set(corr)
+            toks = jnp.where(cols >= take, pad_id, toks)
+            if eos_id is not None:
+                # freeze within the round: positions strictly after the
+                # first eos become pad, matching generate()'s semantics
+                is_eos = (toks == eos_id) & (cols < take)
+                after = (jnp.cumsum(is_eos.astype(jnp.int32), axis=1)
+                         - is_eos.astype(jnp.int32)) > 0
+                toks = jnp.where(after, pad_id, toks)
+                done_new = done | jnp.any(is_eos, axis=1)
+            else:
+                done_new = done
+            toks = jnp.where(done[:, None], pad_id, toks)
+            out = jax.lax.dynamic_update_slice(out, toks, (0, emitted))
+            new_last = jnp.where(done, last, corr)
+            return (emitted + take, new_last, out, done_new, t_caches,
+                    d_caches, rounds + 1, acc + kmin)
+
+        emitted0 = jnp.int32(1)
+        rounds0 = jnp.int32(0)
+        acc0 = jnp.int32(0)
+        (emitted, last, out, done, t_caches, d_caches, rounds, acc) = (
+            jax.lax.while_loop(
+                cond, body,
+                (emitted0, last, out, done, t_caches, d_caches, rounds0,
+                 acc0)))
+        del t_caches, d_caches
+        return {"tokens": out[:, :N],
+                "acceptance": (acc.astype(jnp.float32)
+                               / jnp.maximum(rounds * G, 1)),
+                "rounds": rounds,
+                "tokens_per_target_forward": (
+                    jnp.float32(N) / jnp.maximum(rounds, 1))}
 
     return jax.jit(run)
