@@ -1,3 +1,15 @@
 """Drop-in integrations for third-party model libraries."""
 
 from .hf_flash import flash_attention_for_hf_bert  # noqa: F401
+
+__all__ = ["flash_attention_for_hf_bert"]
+
+
+def __getattr__(name):
+    # torch/transformers import lazily: the gpt2 converter should not
+    # drag them in for users who only want the flash shim
+    if name in ("gpt2_config", "convert_gpt2_state_dict", "load_gpt2"):
+        from . import gpt2
+
+        return getattr(gpt2, name)
+    raise AttributeError(name)

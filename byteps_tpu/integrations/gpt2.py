@@ -1,0 +1,132 @@
+"""GPT-2 architecture compatibility: convert HF ``GPT2LMHeadModel``
+weights into the framework's Transformer.
+
+The reference is a communication library bolted onto existing frameworks;
+this rebuild ships its own model stack, so real-architecture
+compatibility is the bridge for users arriving with trained weights.
+``TransformerConfig`` grew the three axes GPT-2 needs (pre-norm
+LayerNorm with bias, biased projections, lm_head tied to the input
+embedding); this module maps the HF torch state dict onto the framework's
+parameter tree.  Every inference feature then works on GPT-2 weights:
+flash prefill, KV-cache generate, beam search, speculative decoding with
+a smaller GPT-2 as draft, and int8 weight-only quantization.
+
+Weight layout notes (HF GPT-2 uses Conv1D, which stores ``[in, out]`` —
+the same orientation as our kernels, so no transposes except the tied
+head):
+
+* ``wte [V, d]`` -> ``embed.embedding``; ``wpe [P, d]`` -> ``pos``.
+* ``h.i.attn.c_attn [d, 3d]`` -> split thirds -> q/k/v ``[d, H, Dh]``.
+* ``h.i.attn.c_proj [d, d]`` -> o ``[H, Dh, d]`` (HF merges heads
+  H-major, matching the reshape).
+* ``h.i.mlp.c_fc/c_proj`` -> up/down; ``ln_1/ln_2/ln_f`` -> scale+bias.
+* lm_head is tied: no separate tensor (``tie_embeddings=True``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.transformer import Transformer, TransformerConfig
+
+__all__ = ["gpt2_config", "convert_gpt2_state_dict", "load_gpt2"]
+
+
+def gpt2_config(hf_config, dtype=jnp.float32, **overrides):
+    """TransformerConfig mirroring an HF ``GPT2Config``.
+
+    Raises on config axes the framework model does not implement rather
+    than silently diverging from the torch reference.
+    """
+    act = getattr(hf_config, "activation_function", "gelu_new")
+    if act not in ("gelu_new", "gelu_pytorch_tanh"):
+        raise ValueError(
+            f"unsupported activation_function {act!r}: the framework MLP "
+            "hardcodes tanh-approximate GELU (gelu_new)")
+    for flag in ("scale_attn_by_inverse_layer_idx",
+                 "reorder_and_upcast_attn"):
+        if getattr(hf_config, flag, False):
+            raise ValueError(f"unsupported GPT2Config.{flag}=True")
+    kw = dict(
+        vocab_size=hf_config.vocab_size,
+        num_layers=hf_config.n_layer,
+        num_heads=hf_config.n_head,
+        d_model=hf_config.n_embd,
+        d_ff=(hf_config.n_inner if hf_config.n_inner is not None
+              else 4 * hf_config.n_embd),
+        max_seq_len=hf_config.n_positions,
+        dtype=dtype,
+        causal=True,
+        norm="layernorm",
+        norm_eps=hf_config.layer_norm_epsilon,
+        use_bias=True,
+        tie_embeddings=True,
+    )
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def _np(t) -> np.ndarray:
+    return t.detach().cpu().numpy() if hasattr(t, "detach") else np.asarray(t)
+
+
+def convert_gpt2_state_dict(sd: Mapping[str, Any],
+                            cfg: TransformerConfig) -> dict:
+    """Map an HF ``GPT2LMHeadModel.state_dict()`` to a framework params
+    tree for ``Transformer(cfg)`` (cfg from :func:`gpt2_config`)."""
+    d, H = cfg.d_model, cfg.num_heads
+    Dh = d // H
+
+    def g(key):
+        return _np(sd[f"transformer.{key}"]).astype(np.float32)
+
+    params: dict = {
+        "embed": {"embedding": g("wte.weight")},
+        "pos": {"embedding": g("wpe.weight")},
+        "ln_f": {"scale": g("ln_f.weight"), "bias": g("ln_f.bias")},
+    }
+    for i in range(cfg.num_layers):
+        p = f"h.{i}"
+        w_attn = g(f"{p}.attn.c_attn.weight")        # [d, 3d]
+        b_attn = g(f"{p}.attn.c_attn.bias")          # [3d]
+        qw, kw, vw = np.split(w_attn, 3, axis=1)
+        qb, kb, vb = np.split(b_attn, 3, axis=0)
+        params[f"block_{i}"] = {
+            "ln1": {"scale": g(f"{p}.ln_1.weight"),
+                    "bias": g(f"{p}.ln_1.bias")},
+            "ln2": {"scale": g(f"{p}.ln_2.weight"),
+                    "bias": g(f"{p}.ln_2.bias")},
+            "attn": {
+                "q": {"kernel": qw.reshape(d, H, Dh),
+                      "bias": qb.reshape(H, Dh)},
+                "k": {"kernel": kw.reshape(d, H, Dh),
+                      "bias": kb.reshape(H, Dh)},
+                "v": {"kernel": vw.reshape(d, H, Dh),
+                      "bias": vb.reshape(H, Dh)},
+                "o": {"kernel": g(f"{p}.attn.c_proj.weight")
+                      .reshape(H, Dh, d),
+                      "bias": g(f"{p}.attn.c_proj.bias")},
+            },
+            "mlp": {
+                "up": {"kernel": g(f"{p}.mlp.c_fc.weight"),
+                       "bias": g(f"{p}.mlp.c_fc.bias")},
+                "down": {"kernel": g(f"{p}.mlp.c_proj.weight"),
+                         "bias": g(f"{p}.mlp.c_proj.bias")},
+            },
+        }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"kernel": _np(sd["lm_head.weight"]).T
+                             .astype(np.float32)}
+    import jax
+
+    return {"params": jax.tree_util.tree_map(jnp.asarray, params)}
+
+
+def load_gpt2(hf_model, dtype=jnp.float32, **overrides):
+    """``(Transformer, variables)`` from a live ``GPT2LMHeadModel``."""
+    cfg = gpt2_config(hf_model.config, dtype=dtype, **overrides)
+    variables = convert_gpt2_state_dict(hf_model.state_dict(), cfg)
+    return Transformer(cfg), variables

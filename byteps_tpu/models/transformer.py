@@ -52,6 +52,13 @@ class TransformerConfig:
     # Mistral-style causal sliding window (flash impl only, no sp axis):
     # each position attends to the last `attn_window` positions
     attn_window: Optional[int] = None
+    # architecture axes for GPT-2-family compatibility
+    # (integrations/gpt2.py): pre-norm layer norm with bias, biased
+    # projections, and an lm_head tied to the input embedding
+    norm: str = "rmsnorm"  # rmsnorm | layernorm
+    norm_eps: float = 1e-6
+    use_bias: bool = False
+    tie_embeddings: bool = False
     # mesh axis names; attention shard_map uses (dp_axis, sp_axis, tp_axis)
     dp_axis: str = "dp"
     sp_axis: str = "sp"
@@ -66,6 +73,15 @@ class TransformerConfig:
         if self.mesh is not None and self.tp_axis in self.mesh.axis_names:
             return nn.with_partitioning(init, spec)
         return init
+
+    def make_norm(self, name: str):
+        if self.norm == "layernorm":
+            return nn.LayerNorm(epsilon=self.norm_eps, dtype=self.dtype,
+                                name=name)
+        if self.norm != "rmsnorm":
+            raise ValueError(f"unknown norm {self.norm!r}")
+        return nn.RMSNorm(epsilon=self.norm_eps, dtype=self.dtype,
+                          name=name)
 
     @property
     def has_sp(self) -> bool:
@@ -137,6 +153,7 @@ class QuantDense(nn.Module):
     in_axes: int = 1
     dtype: Any = jnp.float32
     kernel_init: Any = nn.initializers.lecun_normal()
+    use_bias: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -168,6 +185,9 @@ class QuantDense(nn.Module):
             x.astype(self.dtype), w,
             ((tuple(range(x.ndim - self.in_axes, x.ndim)),
               tuple(range(self.in_axes))), ((), ())))
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros, feats)
+            y = y + bias.astype(self.dtype)
         return y
 
 
@@ -205,7 +225,7 @@ class Attention(nn.Module):
         cfg = self.cfg
         H, D = cfg.num_heads, cfg.d_model // cfg.num_heads
         proj = partial(
-            QuantDense, dtype=cfg.dtype,
+            QuantDense, dtype=cfg.dtype, use_bias=cfg.use_bias,
             kernel_init=cfg.partition(
                 nn.initializers.xavier_uniform(), (None, cfg.tp_axis, None)
             ),
@@ -215,6 +235,7 @@ class Attention(nn.Module):
         v = proj(features=(H, D), name="v")(x)
         o_proj = QuantDense(
             features=cfg.d_model, in_axes=2, dtype=cfg.dtype, name="o",
+            use_bias=cfg.use_bias,
             kernel_init=cfg.partition(
                 nn.initializers.xavier_uniform(), (cfg.tp_axis, None, None)
             ),
@@ -287,6 +308,7 @@ class MLP(nn.Module):
         cfg = self.cfg
         h = QuantDense(
             features=cfg.d_ff, dtype=cfg.dtype, name="up",
+            use_bias=cfg.use_bias,
             kernel_init=cfg.partition(
                 nn.initializers.xavier_uniform(), (None, cfg.tp_axis)
             ),
@@ -294,6 +316,7 @@ class MLP(nn.Module):
         h = nn.gelu(h)
         return QuantDense(
             features=cfg.d_model, dtype=cfg.dtype, name="down",
+            use_bias=cfg.use_bias,
             kernel_init=cfg.partition(
                 nn.initializers.xavier_uniform(), (cfg.tp_axis, None)
             ),
@@ -305,7 +328,7 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, key_mask=None, cache=None, pos=None):
-        y = nn.RMSNorm(dtype=self.cfg.dtype, name="ln1")(x)
+        y = self.cfg.make_norm("ln1")(x)
         if cache is not None:
             if key_mask is not None:
                 raise ValueError(
@@ -317,7 +340,7 @@ class Block(nn.Module):
         else:
             new_cache = None
             x = x + Attention(self.cfg, name="attn")(y, key_mask=key_mask)
-        y = nn.RMSNorm(dtype=self.cfg.dtype, name="ln2")(x)
+        y = self.cfg.make_norm("ln2")(x)
         x = x + MLP(self.cfg, name="mlp")(y)
         return (x, new_cache) if cache is not None else x
 
@@ -349,10 +372,11 @@ class Transformer(nn.Module):
         self.blocks = [
             Block(cfg, name=f"block_{i}") for i in range(cfg.num_layers)
         ]
-        self.ln_f = nn.RMSNorm(dtype=cfg.dtype, name="ln_f")
-        self.lm_head = QuantDense(
-            cfg.vocab_size, dtype=jnp.float32, name="lm_head",
-        )
+        self.ln_f = cfg.make_norm("ln_f")
+        if not cfg.tie_embeddings:
+            self.lm_head = QuantDense(
+                cfg.vocab_size, dtype=jnp.float32, name="lm_head",
+            )
 
     def hidden(self, tokens):
         """Everything up to (and including) the final norm:
@@ -363,8 +387,18 @@ class Transformer(nn.Module):
             x = block(x)
         return self.ln_f(x)
 
+    def logits(self, h):
+        """LM head over hidden states — the tied variant multiplies by
+        the input embedding table (GPT-2 convention).  Both variants run
+        the head matmul in fp32 (sampling and speculative-accept
+        decisions read these logits; a bf16 head would round them)."""
+        if self.cfg.tie_embeddings:
+            emb = self.embed.embedding
+            return h.astype(jnp.float32) @ emb.astype(jnp.float32).T
+        return self.lm_head(h).astype(jnp.float32)
+
     def __call__(self, tokens):
-        return self.lm_head(self.hidden(tokens)).astype(jnp.float32)
+        return self.logits(self.hidden(tokens))
 
     def decode(self, tokens, caches, pos, last_only=False):
         """One autoregressive step over ``tokens [B, tq]`` at absolute
@@ -389,8 +423,7 @@ class Transformer(nn.Module):
             new_caches.append(nc)
         if last_only:
             x = x[:, -1:]
-        logits = self.lm_head(self.ln_f(x)).astype(jnp.float32)
-        return logits, tuple(new_caches)
+        return self.logits(self.ln_f(x)), tuple(new_caches)
 
 
 def init_cache(cfg: TransformerConfig, batch_size: int, max_len: int):

@@ -184,8 +184,9 @@ def lm_loss_fn(model, fused_head: bool = False,
     B*T rows go to the kernel (keeping N block-divisible for typical
     sequence lengths); the shift-off last position rides the kernel's
     ignore-index semantics (out-of-range target → loss 0, no grad).
-    Requires a model exposing ``hidden`` and an ``lm_head`` Dense
-    (models/transformer.Transformer does).  ``block_n``/``block_v`` pass
+    Requires a model exposing ``hidden`` plus either an ``lm_head``
+    Dense or tied embeddings (models/transformer.Transformer, either
+    way; for tied models the head weight is the embedding transpose).  ``block_n``/``block_v`` pass
     through to the kernel for vocab/batch sizes its auto-fit cannot
     divide (e.g. GPT-2's 50257).
 
@@ -208,7 +209,18 @@ def lm_loss_fn(model, fused_head: bool = False,
             from ..ops.fused_cross_entropy import fused_linear_cross_entropy
 
             h = model.apply({"params": params}, tokens, method=model.hidden)
-            w = params["lm_head"]["kernel"].astype(h.dtype)
+            if "lm_head" in params:
+                w = params["lm_head"]["kernel"].astype(h.dtype)
+            else:
+                # tied-embedding models (tie_embeddings=True) have no
+                # lm_head; the head weight is the embedding transposed.
+                # tp-partitioned trees box the leaf in nn.Partitioned.
+                import flax.linen as nn
+
+                emb = params["embed"]["embedding"]
+                if isinstance(emb, nn.meta.AxisMetadata):
+                    emb = emb.unbox()
+                w = emb.T.astype(h.dtype)
             B, T, d = h.shape
             V = w.shape[-1]
             flat_t = targets.reshape(-1)
