@@ -32,7 +32,73 @@ import jax.numpy as jnp
 from .models.transformer import Transformer, init_cache
 
 __all__ = ["make_generate_fn", "generate", "sample_logits",
-           "quantize_params", "beam_search", "speculative_generate"]
+           "quantize_params", "beam_search", "speculative_generate",
+           "classify_divergence"]
+
+
+def classify_divergence(model: Transformer, variables, prompt,
+                        tokens_a, tokens_b, *, tie_rtol: float = 0.02,
+                        tie_atol: float = 0.05):
+    """Diagnose the first disagreement between two greedy decodes of the
+    same model (e.g. cached vs no-cache, or bf16 vs int8 storage).
+
+    A raw agreement fraction cannot distinguish "bf16 reduction-order
+    flipped a near-tie argmax" (benign, expected) from "the KV cache
+    returned wrong context" (a bug).  This teacher-forces path A's
+    tokens through a single full forward — causal attention makes the
+    logits at the first divergent position ``d`` a function of the
+    agreed prefix only, so both paths saw (numerically nearly) these
+    logits there — and compares the logit of each path's chosen token:
+
+    * identical tokens -> ``{"divergence": "none"}``
+    * ``logit[a_d]`` within ``tie_rtol * span + tie_atol`` of
+      ``logit[b_d]`` -> ``"tie"`` (a near-tie argmax; rounding noise)
+    * otherwise -> ``"real"`` — path B chose a token the model scores
+      clearly lower, i.e. a genuine numerical/cache defect.
+
+    Returns per-batch-row worst case: ``{"divergence", "agreement",
+    "first_div_pos", "delta_logit", "tie_threshold"}``.
+    """
+    import numpy as np
+
+    toks_a = np.asarray(tokens_a)
+    toks_b = np.asarray(tokens_b)
+    assert toks_a.shape == toks_b.shape
+    B, N = toks_a.shape
+    agree = float((toks_a == toks_b).mean())
+    if (toks_a == toks_b).all():
+        return {"divergence": "none", "agreement": 1.0,
+                "first_div_pos": -1, "delta_logit": 0.0}
+    full_a = jnp.concatenate(
+        [jnp.asarray(prompt), jnp.asarray(toks_a)], axis=1)
+    logits = jax.jit(model.apply)(variables, full_a)
+    logits = np.asarray(logits, np.float32)
+    T = prompt.shape[1]
+    worst = {"divergence": "none", "agreement": agree,
+             "first_div_pos": -1, "delta_logit": 0.0,
+             "tie_threshold": 0.0}
+    rank = {"none": 0, "tie": 1, "real": 2}
+    for b in range(B):
+        div = np.nonzero(toks_a[b] != toks_b[b])[0]
+        if not len(div):
+            continue
+        d = int(div[0])
+        # logits that produced generated token d live at sequence
+        # position T + d - 1 (the previous token's output)
+        row = logits[b, T + d - 1]
+        la = float(row[toks_a[b, d]])
+        lb = float(row[toks_b[b, d]])
+        span = float(np.abs(row).max())
+        thr = tie_rtol * span + tie_atol
+        kind = "tie" if abs(la - lb) <= thr else "real"
+        if rank[kind] > rank[worst["divergence"]] or (
+                kind == worst["divergence"]
+                and abs(la - lb) > abs(worst["delta_logit"])):
+            worst = {"divergence": kind, "agreement": agree,
+                     "first_div_pos": d,
+                     "delta_logit": round(la - lb, 4),
+                     "tie_threshold": round(thr, 4)}
+    return worst
 
 
 def quantize_params(params, in_axes_of=None):
@@ -101,6 +167,14 @@ def sample_logits(logits, rng, temperature: float = 1.0,
     with cumulative probability >= top_p (the highest-probability token is
     always kept).  Both filters compose (k first, then p), matching the
     usual HF ``generate`` semantics.
+
+    Tie semantics: ``top_p`` masks by value threshold (smallest kept
+    logit), so a token whose logit exactly equals the threshold survives
+    even if it sat outside the nucleus in sorted order — with fp32
+    logits exact ties are measure-zero, and keeping a tied-equal token
+    is distribution-identical anyway (it has the same probability as the
+    kept one).  HF instead scatters a positional mask back through the
+    argsort; switch to that only if bit-exact HF parity ever matters.
     """
     if temperature == 0:
         return jnp.argmax(logits, axis=-1)
@@ -127,19 +201,41 @@ def make_generate_fn(model: Transformer, max_new_tokens: int, *,
                      top_k: Optional[int] = None,
                      top_p: Optional[float] = None,
                      eos_id: Optional[int] = None,
-                     pad_id: int = 0):
+                     pad_id: int = 0,
+                     kv_quant: bool = False,
+                     cache_len: Optional[int] = None):
     """Build a jitted ``fn(variables, prompt [B, T], rng) -> dict`` that
     appends ``max_new_tokens`` sampled tokens to each prompt row.
 
     The prompt must be fully valid (no padding); rows that emit ``eos_id``
     are frozen to ``pad_id`` for the remaining steps.  Returns
     ``{"tokens": [B, max_new_tokens], "done": [B] bool}``.
+
+    ``kv_quant=True`` decodes against an int8 KV cache (per-position,
+    per-head scales — see ``models.transformer.init_cache``): half the
+    cache HBM stream per token, at a small quantization cost to the
+    attention weights.  Pair with ``quantize_params`` for the full int8
+    decode mode.
+
+    ``cache_len`` over-allocates the KV cache beyond the default
+    ``T + max_new_tokens`` (decode attends over the whole buffer, so a
+    longer cache costs bandwidth — use it to hold geometry constant
+    across program variants, e.g. for benchmarking, or to reuse one
+    compiled program across prompt lengths).
     """
     cfg = model.cfg
 
     def run(variables, prompt, rng):
         B, T = prompt.shape
-        caches = init_cache(cfg, B, T + max_new_tokens)
+        need = T + max_new_tokens
+        if cache_len is not None and cache_len < need:
+            # dynamic_update_slice would silently clamp out-of-range
+            # writes onto the last slot, corrupting generation
+            raise ValueError(
+                f"cache_len={cache_len} < prompt + max_new_tokens "
+                f"({need})")
+        caches = init_cache(cfg, B, cache_len or need,
+                            quantized=kv_quant)
         # prefill: one batched forward writes the prompt's K/V into the
         # cache; last_only keeps the LM head off the T-1 positions whose
         # [B, T, vocab] fp32 logits nobody reads
@@ -148,15 +244,22 @@ def make_generate_fn(model: Transformer, max_new_tokens: int, *,
         rng, sub = jax.random.split(rng)
         tok = sample_logits(logits[:, -1], sub, temperature, top_k, top_p)
         done = (tok == eos_id) if eos_id is not None else jnp.zeros(B, bool)
+        greedy = temperature == 0
 
         def step(carry, i):
             caches, tok, done, rng = carry
             logits, caches = model.apply(
                 variables, tok[:, None], caches, T + i,
                 method=Transformer.decode)
-            rng, sub = jax.random.split(rng)
-            nxt = sample_logits(
-                logits[:, -1], sub, temperature, top_k, top_p)
+            if greedy:
+                # no per-step rng: the carried key would force a threefry
+                # split every step that DCE cannot remove (the key is
+                # loop state), a pure tax on the decode critical path
+                nxt = sample_logits(logits[:, -1], rng, 0.0)
+            else:
+                rng, sub = jax.random.split(rng)
+                nxt = sample_logits(
+                    logits[:, -1], sub, temperature, top_k, top_p)
             nxt = jnp.where(done, pad_id, nxt)
             if eos_id is not None:
                 done = done | (nxt == eos_id)
@@ -170,21 +273,72 @@ def make_generate_fn(model: Transformer, max_new_tokens: int, *,
             [jnp.moveaxis(toks, 0, 1), tok[:, None]], axis=1)
         return {"tokens": tokens, "done": done}
 
-    return jax.jit(run)
+    return _layout_aware_jit(run)
+
+
+def _layout_aware_jit(run):
+    """jit ``run(variables, prompt, rng)``; int8 trees on TPU compile
+    with AUTO input layouts.
+
+    XLA's default entry layout for s8 parameters streams at roughly half
+    the chip's HBM rate through the decode loop's mixed s8 dots; letting
+    the compiler choose the layout (``Format(Layout.AUTO)``) recovers
+    full rate — measured r4 on v5e: 0.49 -> 0.37 ms/token.  The params
+    are ``device_put`` into the chosen layout on first use (a no-op copy
+    on subsequent calls, since the placed tree is returned to the cache).
+    Float trees see no effect from AUTO and take the plain jit path.
+    """
+    plain = jax.jit(run)
+    try:
+        from jax.experimental.layout import Format, Layout
+        auto_jit = jax.jit(run, in_shardings=Format(Layout.AUTO))
+    except Exception:  # pragma: no cover - older jax
+        return plain
+    cache: dict = {}
+
+    def call(variables, prompt, rng):
+        leaves = jax.tree_util.tree_leaves(variables)
+        has_int8 = any(getattr(x, "dtype", None) == jnp.int8
+                       for x in leaves)
+        if not has_int8 or jax.default_backend() not in ("tpu", "axon"):
+            return plain(variables, prompt, rng)
+        key = (jax.tree_util.tree_structure((variables, prompt, rng)),
+               tuple((x.shape, str(x.dtype)) for x in leaves),
+               prompt.shape, str(prompt.dtype))
+        ent = cache.get(key)
+        if ent is None:
+            compiled = auto_jit.lower(variables, prompt, rng).compile()
+            cache[key] = ent = (compiled, compiled.input_formats[0], {})
+        compiled, formats, placed = ent
+        # re-lay the params once per distinct tree — keyed on EVERY
+        # leaf's identity (a tree sharing just its first leaf with a
+        # previously placed one must not reuse it); the leaves are held
+        # in the cache entry so no id can be recycled
+        pkey = tuple(id(x) for x in leaves)
+        hit = placed.get(pkey)
+        if hit is None:
+            placed.clear()  # one placed copy alive at a time
+            placed[pkey] = hit = (
+                list(leaves), jax.device_put(variables, formats[0]))
+        pvars = hit[1]
+        p, r = jax.device_put((prompt, rng), (formats[1], formats[2]))
+        return compiled(pvars, p, r)
+
+    return call
 
 
 @functools.lru_cache(maxsize=32)
 def _cached_fn(model, max_new_tokens, temperature, top_k, top_p, eos_id,
-               pad_id):
+               pad_id, kv_quant=False):
     return make_generate_fn(
         model, max_new_tokens, temperature=temperature, top_k=top_k,
-        top_p=top_p, eos_id=eos_id, pad_id=pad_id)
+        top_p=top_p, eos_id=eos_id, pad_id=pad_id, kv_quant=kv_quant)
 
 
 def generate(model: Transformer, variables, prompt, max_new_tokens: int, *,
              temperature: float = 1.0, top_k: Optional[int] = None,
              top_p: Optional[float] = None, eos_id: Optional[int] = None,
-             pad_id: int = 0, rng=None):
+             pad_id: int = 0, rng=None, kv_quant: bool = False):
     """Convenience wrapper around :func:`make_generate_fn` (memoized on the
     static arguments, so repeated calls reuse the compiled program).
 
@@ -200,13 +354,14 @@ def generate(model: Transformer, variables, prompt, max_new_tokens: int, *,
                 "distinct sample)")
         rng = jax.random.PRNGKey(0)
     fn = _cached_fn(model, max_new_tokens, temperature, top_k, top_p,
-                    eos_id, pad_id)
+                    eos_id, pad_id, kv_quant)
     return fn(variables, prompt, rng)
 
 
 def beam_search(model: Transformer, variables, prompt, max_new_tokens: int,
                 num_beams: int, *, length_penalty: float = 1.0,
-                eos_id: Optional[int] = None, pad_id: int = 0):
+                eos_id: Optional[int] = None, pad_id: int = 0,
+                cache_len: Optional[int] = None):
     """Beam-search decoding with the KV cache: returns the highest-scoring
     continuation per batch row.
 
@@ -230,13 +385,13 @@ def beam_search(model: Transformer, variables, prompt, max_new_tokens: int,
     "beam_scores": [B, num_beams]}`` — tokens/scores are the best beam's.
     """
     fn = _cached_beam_fn(model, max_new_tokens, num_beams,
-                         length_penalty, eos_id, pad_id)
+                         length_penalty, eos_id, pad_id, cache_len)
     return fn(variables, prompt)
 
 
 @functools.lru_cache(maxsize=32)
 def _cached_beam_fn(model, max_new_tokens, num_beams, length_penalty,
-                    eos_id, pad_id):
+                    eos_id, pad_id, cache_len=None):
     cfg = model.cfg
     K = num_beams
     V = cfg.vocab_size
@@ -245,7 +400,11 @@ def _cached_beam_fn(model, max_new_tokens, num_beams, length_penalty,
 
     def run(variables, prompt):
         B, T = prompt.shape
-        caches = init_cache(cfg, B, T + N)
+        if cache_len is not None and cache_len < T + N:
+            raise ValueError(
+                f"cache_len={cache_len} < prompt + max_new_tokens "
+                f"({T + N})")
+        caches = init_cache(cfg, B, cache_len or (T + N))
         logits, caches = model.apply(
             variables, prompt, caches, 0, True, method=Transformer.decode)
         logprobs = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))
@@ -314,7 +473,8 @@ def _cached_beam_fn(model, max_new_tokens, num_beams, length_penalty,
 def speculative_generate(target: Transformer, target_vars,
                          draft: Transformer, draft_vars,
                          prompt, max_new_tokens: int, *, gamma: int = 4,
-                         eos_id: Optional[int] = None, pad_id: int = 0):
+                         eos_id: Optional[int] = None, pad_id: int = 0,
+                         cache_len: Optional[int] = None):
     """Greedy speculative decoding: a small draft model proposes ``gamma``
     tokens autoregressively, the target model verifies them in ONE
     ``gamma+1``-token decode, and the longest agreeing prefix is accepted
@@ -335,18 +495,24 @@ def speculative_generate(target: Transformer, target_vars,
     "acceptance": mean accepted-per-round fraction}``.
     """
     fn = _cached_spec_fn(target, draft, max_new_tokens, gamma, eos_id,
-                         pad_id)
+                         pad_id, cache_len)
     return fn(target_vars, draft_vars, prompt)
 
 
 @functools.lru_cache(maxsize=16)
-def _cached_spec_fn(target, draft, max_new_tokens, gamma, eos_id, pad_id):
+def _cached_spec_fn(target, draft, max_new_tokens, gamma, eos_id, pad_id,
+                    cache_len=None):
     N, G = max_new_tokens, gamma
     tcfg, dcfg = target.cfg, draft.cfg
 
     def run(target_vars, draft_vars, prompt):
         B, T = prompt.shape
-        S = T + N + G + 1
+        need = T + N + G + 1
+        if cache_len is not None and cache_len < need:
+            raise ValueError(
+                f"cache_len={cache_len} < prompt + max_new_tokens + "
+                f"gamma + 1 ({need})")
+        S = cache_len or need
         t_caches = init_cache(tcfg, B, S)
         d_caches = init_cache(dcfg, B, S)
         # prefill both models; the target's last-position logits give the
@@ -370,7 +536,8 @@ def _cached_spec_fn(target, draft, max_new_tokens, gamma, eos_id, pad_id):
             return c[0] < N
 
         def body(c):
-            emitted, last, out, done, t_caches, d_caches, rounds, acc = c
+            (emitted, last, out, done, t_caches, d_caches, rounds, acc,
+             live_slots) = c
             pos = T + emitted - 1
 
             # draft G tokens with the small model
@@ -428,21 +595,28 @@ def _cached_spec_fn(target, draft, max_new_tokens, gamma, eos_id, pad_id):
             toks = jnp.where(done[:, None], pad_id, toks)
             out = jax.lax.dynamic_update_slice(out, toks, (0, emitted))
             new_last = jnp.where(done, last, corr)
+            # acceptance accounting over LIVE rows only: finished rows
+            # draft nothing real (kmin treats them as accepting G via the
+            # batch-min), so counting their slots would inflate the rate
+            # on eos-terminated batches
+            n_live = jnp.sum(jnp.where(done, 0, 1))
             return (emitted + take, new_last, out, done_new, t_caches,
-                    d_caches, rounds + 1, acc + kmin)
+                    d_caches, rounds + 1, acc + kmin * n_live,
+                    live_slots + G * n_live)
 
         emitted0 = jnp.int32(1)
         rounds0 = jnp.int32(0)
         acc0 = jnp.int32(0)
-        (emitted, last, out, done, t_caches, d_caches, rounds, acc) = (
+        (emitted, last, out, done, t_caches, d_caches, rounds, acc,
+         live_slots) = (
             jax.lax.while_loop(
                 cond, body,
                 (emitted0, last, out, done, t_caches, d_caches, rounds0,
-                 acc0)))
+                 acc0, jnp.int32(0))))
         del t_caches, d_caches
         return {"tokens": out[:, :N],
                 "acceptance": (acc.astype(jnp.float32)
-                               / jnp.maximum(rounds * G, 1)),
+                               / jnp.maximum(live_slots, 1)),
                 "rounds": rounds,
                 "tokens_per_target_forward": (
                     jnp.float32(N) / jnp.maximum(rounds, 1))}

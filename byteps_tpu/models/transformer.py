@@ -154,6 +154,14 @@ class QuantDense(nn.Module):
     dtype: Any = jnp.float32
     kernel_init: Any = nn.initializers.lecun_normal()
     use_bias: bool = False
+    # accumulate/output dtype of the dot when it differs from the operand
+    # dtype (preferred_element_type).  The lm_head uses dtype=bf16,
+    # accum_dtype=f32: bf16 operands stream at half the HBM bytes while
+    # the MXU still accumulates and emits fp32 logits.  An explicit
+    # .astype(f32) on a bf16 dot's OUTPUT would be (nearly) the same math
+    # but reads the weight as a separate bf16->f32 convert instruction,
+    # which XLA materializes as a full-size temp inside a decode loop.
+    accum_dtype: Any = None
 
     @nn.compact
     def __call__(self, x):
@@ -161,34 +169,95 @@ class QuantDense(nn.Module):
                  else (self.features,))
         kshape = tuple(x.shape[-self.in_axes:]) + feats
         kernel = self.param("kernel", self.kernel_init, kshape)
-        if self.has_variable("params", "scale"):
+        quantized = self.has_variable("params", "scale")
+        dims = ((tuple(range(x.ndim - self.in_axes, x.ndim)),
+                 tuple(range(self.in_axes))), ((), ()))
+        out_dtype = self.accum_dtype if self.accum_dtype else self.dtype
+        if quantized:
             scale = self.get_variable("params", "scale")
-            # tie the dequant to the (loop-varying) activation with an
-            # exact zero: without this data dependence XLA's loop-
-            # invariant code motion hoists converted bf16 weight copies
-            # out of the decode scan, doubling weight HBM residency and
-            # defeating the int8 *footprint* win (optimization_barrier
-            # does NOT stop LICM — the barrier chain is itself invariant
-            # and moves out whole).  With the dependence, the compiled
-            # while body carries s8 kernels and fuses dequant into the
-            # dots (verified in optimized HLO).  isfinite-guarded so a
-            # NaN/inf activation cannot poison the scale.  Measured on
-            # the bench chip: no decode *speed* change either way (see
-            # docs/performance.md) — the win is memory, not time.
-            v = x.ravel()[0].astype(jnp.float32)
-            eps = jnp.where(jnp.isfinite(v), v, 0.0) * 0.0
-            w = (kernel.astype(self.dtype)
-                 * (scale + eps).astype(self.dtype))
+            if isinstance(scale, nn.meta.AxisMetadata):
+                # a tp-sharded quantized tree may arrive still boxed
+                # (nn.Partitioned); self.param unboxes automatically but
+                # get_variable does not
+                scale = scale.unbox()
+            # int8 weight-only: the dot consumes the s8 kernel DIRECTLY
+            # (mixed s8 x bf16 dot) — an explicit kernel.astype(bf16)
+            # compiles to a standalone convert that materializes a
+            # full-size bf16 temp every decode step (XLA LICM must then
+            # be defeated, and even in-body the temp's write+read triples
+            # the traffic; measured on-chip r4).  The per-output-channel
+            # scale commutes out of the contraction — x @ (q * s) ==
+            # (x @ q) * s — so dequant applies to the [..., out]
+            # activation after the dot.  (A per-dot Pallas dequant kernel
+            # was measured slower here: 73 small pallas_calls per decode
+            # step pay more in launch overhead than the s8 stream saves;
+            # the mixed dot + AUTO input layouts — see
+            # inference.make_generate_fn — reads s8 at full rate.)
+            y = jax.lax.dot_general(
+                x.astype(self.dtype), kernel, dims,
+                preferred_element_type=out_dtype)
+            y = y * scale.astype(out_dtype)
         else:
-            w = kernel.astype(self.dtype)
-        y = jax.lax.dot_general(
-            x.astype(self.dtype), w,
-            ((tuple(range(x.ndim - self.in_axes, x.ndim)),
-              tuple(range(self.in_axes))), ((), ())))
+            y = jax.lax.dot_general(
+                x.astype(self.dtype), kernel.astype(self.dtype), dims,
+                preferred_element_type=out_dtype)
         if self.use_bias:
             bias = self.param("bias", nn.initializers.zeros, feats)
-            y = y + bias.astype(self.dtype)
+            y = y + bias.astype(out_dtype)
         return y
+
+
+def _quantize_kv(x):
+    """Per-(position, head) symmetric int8 quantization of K or V
+    ``[B, t, H, D]`` -> (s8 values, f32 scales ``[B, t, H]``)."""
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                 -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def _cached_attention_q8(q, ck, ck_scale, cv, cv_scale, pos, window=None):
+    """Dense cached attention against an int8-quantized KV cache
+    (``ck/cv [B, S, H, D]`` s8 with per-(position, head) f32 scales).
+
+    The dequant never materializes: K's scale commutes out of the QK^T
+    contraction (it is constant along D), so the score dot runs mixed
+    ``bf16 x s8`` and the scale multiplies the [B, H, tq, S] scores;
+    V's scale is constant along the *contracted* S axis, so it folds
+    into the probabilities before the mixed PV dot — the cache streams
+    s8 bytes end to end, halving decode's second-largest HBM read.
+    """
+    scale = q.shape[-1] ** -0.5
+    # scores[b,h,q,k] = sum_d q[b,q,h,d] * ck[b,k,h,d]  (mixed s8 dot).
+    # preferred_element_type MUST stay the operand dtype: asking the
+    # mixed dot for an f32 output makes XLA convert the whole s8 cache
+    # to a materialized f32 temp every step (observed r4) — the dot
+    # accumulates f32 internally either way, and the [B, H, tq, S]
+    # scores are upcast right after, which is cheap.
+    scores = jax.lax.dot_general(
+        (q * scale).astype(q.dtype), ck,
+        (((3,), (3,)), ((0, 2), (0, 2))),
+        preferred_element_type=q.dtype)                # [B, H, tq, S]
+    scores = (scores.astype(jnp.float32)
+              * jnp.transpose(ck_scale, (0, 2, 1))[:, :, None, :])
+    kidx = jnp.arange(ck.shape[1])[None, None, None, :]
+    qidx = (pos + jnp.arange(q.shape[1]))[None, None, :, None]
+    mask = kidx <= qidx
+    if window is not None:
+        mask = mask & (kidx > qidx - window)
+    scores = jnp.where(mask, scores, jnp.float32(-1e30))
+    probs = jax.nn.softmax(scores, axis=-1)
+    probs = (probs
+             * jnp.transpose(cv_scale, (0, 2, 1))[:, :, None, :]
+             ).astype(q.dtype)
+    # out[b,h,q,d] = sum_k probs[b,h,q,k] * cv[b,k,h,d]  (mixed s8 dot;
+    # same rule — output at operand dtype so the s8 cache is consumed
+    # directly)
+    out = jax.lax.dot_general(
+        probs, cv, (((3,), (1,)), ((0, 1), (0, 2))),
+        preferred_element_type=q.dtype)                # [B, H, tq, D]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 
 
 def _cached_attention(q, ck, cv, pos, window=None):
@@ -251,15 +320,40 @@ class Attention(nn.Module):
                     "KV-cache decode does not support key_mask: pad "
                     "tokens' K/V would enter the cache as real context. "
                     "Strip padding from the prompt before generate().")
-            ck = jax.lax.dynamic_update_slice(
-                cache["k"], k.astype(cache["k"].dtype), (0, pos, 0, 0))
-            cv = jax.lax.dynamic_update_slice(
-                cache["v"], v.astype(cache["v"].dtype), (0, pos, 0, 0))
             import math as _math
 
-            if (isinstance(pos, int) and pos == 0 and x.shape[1] > 1
-                    and cfg.attn_impl == "flash" and not cfg.has_sp
-                    and _math.gcd(x.shape[1], 1024) >= 128):
+            quant_cache = cache["k"].dtype == jnp.int8
+            prefill_flash = (
+                isinstance(pos, int) and pos == 0 and x.shape[1] > 1
+                and cfg.attn_impl == "flash" and not cfg.has_sp
+                and _math.gcd(x.shape[1], 1024) >= 128)
+            if quant_cache:
+                # int8 KV cache: K/V quantize at write time (per
+                # position+head scales); reads stay s8 end to end
+                # (_cached_attention_q8), halving the cache stream that
+                # dominates decode HBM traffic after the weights
+                kq, ks = _quantize_kv(k)
+                vq, vs = _quantize_kv(v)
+                ck = jax.lax.dynamic_update_slice(
+                    cache["k"], kq, (0, pos, 0, 0))
+                cv = jax.lax.dynamic_update_slice(
+                    cache["v"], vq, (0, pos, 0, 0))
+                cks = jax.lax.dynamic_update_slice(
+                    cache["k_scale"], ks.astype(cache["k_scale"].dtype),
+                    (0, pos, 0))
+                cvs = jax.lax.dynamic_update_slice(
+                    cache["v_scale"], vs.astype(cache["v_scale"].dtype),
+                    (0, pos, 0))
+                new_cache = {"k": ck, "v": cv,
+                             "k_scale": cks, "v_scale": cvs}
+            else:
+                ck = jax.lax.dynamic_update_slice(
+                    cache["k"], k.astype(cache["k"].dtype), (0, pos, 0, 0))
+                cv = jax.lax.dynamic_update_slice(
+                    cache["v"], v.astype(cache["v"].dtype), (0, pos, 0, 0))
+                new_cache = {"k": ck, "v": cv}
+
+            if prefill_flash:
                 # prefill fast path: at a *static* pos=0 the valid keys are
                 # exactly the q/k/v just computed, so the causal Pallas
                 # kernel serves prefill directly — O(T) memory instead of
@@ -267,15 +361,20 @@ class Attention(nn.Module):
                 # model trains with (1.96x at T=2048).  The gcd gate keeps
                 # awkward prompt lengths (tiny, or T>1024 coprime with the
                 # kernel's block) on the dense path, where the Pallas
-                # block fitter would crash or degrade to slivers.
+                # block fitter would crash or degrade to slivers.  (With a
+                # quantized cache, prefill attention reads the exact
+                # pre-quantization K/V — only later reads see s8.)
                 from ..ops.flash_attention import flash_attention
 
                 out = flash_attention(q, k, v, causal=True,
                                       window=cfg.attn_window)
+            elif quant_cache:
+                out = _cached_attention_q8(q, ck, cks, cv, cvs, pos,
+                                           window=cfg.attn_window)
             else:
                 out = _cached_attention(q, ck, cv, pos,
                                         window=cfg.attn_window)
-            return o_proj(out), {"k": ck, "v": cv}
+            return o_proj(out), new_cache
         if key_mask is not None:
             if cfg.attn_impl == "flash" and not cfg.has_sp:
                 # padding mask rides the flash kernel's segment ids (pads
@@ -374,8 +473,13 @@ class Transformer(nn.Module):
         ]
         self.ln_f = cfg.make_norm("ln_f")
         if not cfg.tie_embeddings:
+            # bf16 operands + fp32 accumulate: sampling still sees fp32
+            # logits (MXU accumulates fp32 regardless) but the vocab-wide
+            # kernel — the single largest per-token HBM stream in decode —
+            # moves at 2 bytes/param instead of 4
             self.lm_head = QuantDense(
-                cfg.vocab_size, dtype=jnp.float32, name="lm_head",
+                cfg.vocab_size, dtype=cfg.dtype,
+                accum_dtype=jnp.float32, name="lm_head",
             )
 
     def hidden(self, tokens):
@@ -389,12 +493,18 @@ class Transformer(nn.Module):
 
     def logits(self, h):
         """LM head over hidden states — the tied variant multiplies by
-        the input embedding table (GPT-2 convention).  Both variants run
-        the head matmul in fp32 (sampling and speculative-accept
-        decisions read these logits; a bf16 head would round them)."""
+        the input embedding table (GPT-2 convention).  Both variants
+        ACCUMULATE in fp32 (sampling and speculative-accept decisions
+        read these logits) while streaming the vocab-wide weight at the
+        model dtype — the head weight is decode's largest per-token HBM
+        read, and an fp32-operand head would double it."""
+        cdt = self.cfg.dtype
         if self.cfg.tie_embeddings:
             emb = self.embed.embedding
-            return h.astype(jnp.float32) @ emb.astype(jnp.float32).T
+            return jax.lax.dot_general(
+                h.astype(cdt), emb.astype(cdt),
+                (((h.ndim - 1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
         return self.lm_head(h).astype(jnp.float32)
 
     def __call__(self, tokens):
@@ -426,15 +536,30 @@ class Transformer(nn.Module):
         return self.logits(self.ln_f(x)), tuple(new_caches)
 
 
-def init_cache(cfg: TransformerConfig, batch_size: int, max_len: int):
+def init_cache(cfg: TransformerConfig, batch_size: int, max_len: int,
+               quantized: bool = False):
     """Zeroed per-layer KV caches ``[B, max_len, H, D]`` for
     ``Transformer.decode``.  ``max_len`` must cover prompt + new tokens
-    and stay within ``cfg.max_seq_len`` (position embeddings)."""
+    and stay within ``cfg.max_seq_len`` (position embeddings).
+
+    ``quantized=True`` builds an int8 cache (s8 K/V plus f32
+    per-(position, head) scales): half the HBM bytes per decode step,
+    quantization happens at write time inside ``Attention``.  Unwritten
+    slots are masked out of attention, so the zero scales never feed the
+    softmax."""
     if max_len > cfg.max_seq_len:
         raise ValueError(
             f"cache max_len {max_len} exceeds max_seq_len {cfg.max_seq_len}")
     H, D = cfg.num_heads, cfg.d_model // cfg.num_heads
     shape = (batch_size, max_len, H, D)
+    if quantized:
+        return tuple(
+            {"k": jnp.zeros(shape, jnp.int8),
+             "v": jnp.zeros(shape, jnp.int8),
+             "k_scale": jnp.zeros(shape[:3], jnp.float32),
+             "v_scale": jnp.zeros(shape[:3], jnp.float32)}
+            for _ in range(cfg.num_layers)
+        )
     return tuple(
         {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
         for _ in range(cfg.num_layers)

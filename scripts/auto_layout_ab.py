@@ -1,0 +1,89 @@
+"""Same-session A/B: does Format(Layout.AUTO) actually speed the s8
+decode stream?  Bare decode scan, cache S=512 (product geometry),
+variants interleaved, two-length differenced.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.layout import Format, Layout
+
+sys.path.insert(0, ".")
+
+from byteps_tpu.common.timing import readback_barrier
+from byteps_tpu.inference import quantize_params
+from byteps_tpu.models import Transformer, TransformerConfig
+from byteps_tpu.models.transformer import init_cache
+
+gB, S = 8, 512
+L_S, L_L = 32, 255
+cfg = TransformerConfig(vocab_size=32000, num_layers=12, num_heads=12,
+                        d_model=768, d_ff=3072, max_seq_len=S,
+                        dtype=jnp.bfloat16)
+model = Transformer(cfg)
+tok0 = jnp.zeros((gB,), jnp.int32)
+variables = model.init(jax.random.PRNGKey(0), tok0[:, None])
+bf16_tree = jax.tree_util.tree_map(
+    lambda x: x.astype(jnp.bfloat16)
+    if jnp.issubdtype(x.dtype, jnp.floating) else x, variables)
+q_tree = {"params": quantize_params(variables["params"])}
+
+
+def make(steps):
+    def decode_scan(tree, tok0):
+        caches = init_cache(cfg, gB, S)
+
+        def step(carry, pos):
+            caches, tok = carry
+            logits, caches = model.apply(tree, tok[:, None], caches, pos,
+                                         method=Transformer.decode)
+            nxt = jnp.argmax(logits[:, -1], axis=-1)
+            return (caches, nxt), ()
+
+        (caches, tok), _ = jax.lax.scan(
+            step, (caches, tok0), 64 + (jnp.arange(steps) % (S - 64)))
+        return tok
+
+    return decode_scan
+
+
+entries = {}
+for name, tree, auto in [("bf16      ", bf16_tree, False),
+                         ("int8 plain", q_tree, False),
+                         ("int8 AUTO ", q_tree, True)]:
+    if auto:
+        cs = jax.jit(make(L_S), in_shardings=Format(Layout.AUTO)
+                     ).lower(tree, tok0).compile()
+        cl = jax.jit(make(L_L), in_shardings=Format(Layout.AUTO)
+                     ).lower(tree, tok0).compile()
+        tr, tk = jax.device_put((tree, tok0), cl.input_formats[0])
+        # short program may have chosen different layouts; re-lay its own
+        trs, tks = jax.device_put((tree, tok0), cs.input_formats[0])
+        entries[name] = (cs, cl, (trs, tks), (tr, tk))
+    else:
+        cs = jax.jit(make(L_S)).lower(tree, tok0).compile()
+        cl = jax.jit(make(L_L)).lower(tree, tok0).compile()
+        entries[name] = (cs, cl, (tree, tok0), (tree, tok0))
+
+print("device:", jax.devices()[0].device_kind, flush=True)
+for name, (cs, cl, a_s, a_l) in entries.items():
+    readback_barrier(cs(*a_s), cl(*a_l))
+
+best_s = {n: float("inf") for n in entries}
+best_l = {n: float("inf") for n in entries}
+for _ in range(6):
+    for name, (cs, cl, a_s, a_l) in entries.items():
+        t0 = time.perf_counter()
+        readback_barrier(cs(*a_s))
+        best_s[name] = min(best_s[name], time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        readback_barrier(cl(*a_l))
+        best_l[name] = min(best_l[name], time.perf_counter() - t0)
+
+for name in entries:
+    ms = (best_l[name] - best_s[name]) / (L_L - L_S) * 1e3
+    print(f"{name}: {ms:.3f} ms/token true", flush=True)

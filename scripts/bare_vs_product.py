@@ -1,0 +1,88 @@
+"""Same-session: bare decode scan vs make_generate_fn product path, bf16
+vs int8 weights, S=512 geometry, median-of-adjacent-pairs estimator."""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+
+from byteps_tpu.common.timing import readback_barrier
+from byteps_tpu.inference import make_generate_fn, quantize_params
+from byteps_tpu.models import Transformer, TransformerConfig
+from byteps_tpu.models.transformer import init_cache
+
+gB, gT, S = 8, 256, 512
+N_S, N_L = 32, 256
+cfg = TransformerConfig(vocab_size=32000, num_layers=12, num_heads=12,
+                        d_model=768, d_ff=3072, max_seq_len=S,
+                        dtype=jnp.bfloat16)
+model = Transformer(cfg)
+prompt = jax.random.randint(jax.random.PRNGKey(11), (gB, gT), 0,
+                            cfg.vocab_size)
+variables = model.init(jax.random.PRNGKey(12), prompt)
+rng = jax.random.PRNGKey(0)
+bf16_tree = jax.tree_util.tree_map(
+    lambda x: x.astype(jnp.bfloat16)
+    if jnp.issubdtype(x.dtype, jnp.floating) else x, variables)
+q_tree = {"params": quantize_params(variables["params"])}
+
+
+def make_bare(steps):
+    @jax.jit
+    def f(tree, tok0):
+        caches = init_cache(cfg, gB, S)
+
+        def step(carry, pos):
+            caches, tok = carry
+            logits, caches = model.apply(tree, tok[:, None], caches, pos,
+                                         method=Transformer.decode)
+            return (caches, jnp.argmax(logits[:, -1], -1)), ()
+
+        (c, tok), _ = jax.lax.scan(step, (caches, tok0),
+                                   gT + (jnp.arange(steps) % (S - gT)))
+        return tok
+
+    return f
+
+
+tok0 = jnp.zeros((gB,), jnp.int32)
+gen_s = make_generate_fn(model, N_S, temperature=0, cache_len=S)
+gen_l = make_generate_fn(model, N_L, temperature=0, cache_len=S)
+bare_s, bare_l = make_bare(31), make_bare(255)
+
+variants = [
+    ("bare bf16", lambda: bare_s(bf16_tree, tok0),
+     lambda: bare_l(bf16_tree, tok0), 224),
+    ("bare int8", lambda: bare_s(q_tree, tok0),
+     lambda: bare_l(q_tree, tok0), 224),
+    ("prod bf16", lambda: gen_s(bf16_tree, prompt, rng),
+     lambda: gen_l(bf16_tree, prompt, rng), 224),
+    ("prod int8", lambda: gen_s(q_tree, prompt, rng),
+     lambda: gen_l(q_tree, prompt, rng), 224),
+]
+print("device:", jax.devices()[0].device_kind, flush=True)
+for name, fs, fl, _ in variants:
+    readback_barrier(fs(), fl())
+
+diffs = {n: [] for n, _, _, _ in variants}
+for _ in range(10):
+    for name, fs, fl, _ in variants:
+        t0 = time.perf_counter()
+        readback_barrier(fs())
+        ts = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        readback_barrier(fl())
+        tl = time.perf_counter() - t0
+        diffs[name].append(tl - ts)
+
+for name, _, _, steps in variants:
+    d = sorted(diffs[name])
+    n = len(d)
+    med = d[n // 2] if n % 2 else 0.5 * (d[n // 2 - 1] + d[n // 2])
+    print(f"{name}: {med / steps * 1e3:.3f} ms/token "
+          f"(p10-p90 {(d[-2] - d[1]) / steps * 1e3:.3f})", flush=True)

@@ -1,0 +1,85 @@
+"""Does routing decode weights through compiler-produced copies (as the
+f32->bf16 hoisted converts do) beat reading user-provided param buffers?
+
+Variants: bf16 params as-is; bf16 params re-materialized inside the jit
+(x * traced_one — not constant-foldable, so XLA must produce fresh
+buffers); int8 likewise; f32 masters (hoisted-convert baseline).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+
+from byteps_tpu.common.timing import readback_barrier
+from byteps_tpu.inference import quantize_params
+from byteps_tpu.models import Transformer, TransformerConfig
+from byteps_tpu.models.transformer import init_cache
+
+STEPS = 255
+gB, S = 8, 320
+cfg = TransformerConfig(vocab_size=32000, num_layers=12, num_heads=12,
+                        d_model=768, d_ff=3072, max_seq_len=S,
+                        dtype=jnp.bfloat16)
+model = Transformer(cfg)
+tok0 = jnp.zeros((gB,), jnp.int32)
+variables = model.init(jax.random.PRNGKey(0), tok0[:, None])
+bf16_tree = jax.tree_util.tree_map(
+    lambda x: x.astype(jnp.bfloat16)
+    if jnp.issubdtype(x.dtype, jnp.floating) else x, variables)
+q_tree = {"params": quantize_params(variables["params"])}
+
+
+def make(repack):
+    @jax.jit
+    def decode_scan(tree, tok0, one):
+        if repack:
+            tree = jax.tree_util.tree_map(
+                lambda x: x * one.astype(x.dtype), tree)
+
+        caches = init_cache(cfg, gB, S)
+
+        def step(carry, pos):
+            caches, tok = carry
+            logits, caches = model.apply(tree, tok[:, None], caches, pos,
+                                         method=Transformer.decode)
+            nxt = jnp.argmax(logits[:, -1], axis=-1)
+            return (caches, nxt), ()
+
+        (caches, tok), _ = jax.lax.scan(step, (caches, tok0),
+                                        jnp.arange(STEPS) % S)
+        return tok
+
+    return decode_scan
+
+
+one = jnp.int32(1)
+variants = [
+    ("f32 masters      ", variables, make(False)),
+    ("bf16 as-is       ", bf16_tree, make(False)),
+    ("bf16 repacked    ", bf16_tree, make(True)),
+    ("int8 as-is       ", q_tree, make(False)),
+    ("int8 repacked    ", q_tree, make(True)),
+]
+
+print("device:", jax.devices()[0].device_kind, flush=True)
+compiled = {}
+for name, tree, fn in variants:
+    compiled[name] = fn.lower(tree, tok0, one).compile()
+    readback_barrier(compiled[name](tree, tok0, one))
+
+best = {name: float("inf") for name, _, _ in variants}
+for _ in range(6):
+    for name, tree, _ in variants:
+        t0 = time.perf_counter()
+        out = compiled[name](tree, tok0, one)
+        readback_barrier(out)
+        best[name] = min(best[name], time.perf_counter() - t0)
+
+for name, _, _ in variants:
+    print(f"{name}: {best[name]/STEPS*1e3:.3f} ms/token", flush=True)

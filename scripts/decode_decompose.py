@@ -1,0 +1,80 @@
+"""Decompose decode step time into per-layer overhead vs HBM bytes.
+
+Times a pure-decode scan for several (layers, d_model, cache S) variants on
+bf16 params, then fits t_step = a*L + bytes/BW to see what actually bounds
+decode on this chip.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+
+from byteps_tpu.common.timing import readback_barrier
+from byteps_tpu.models import Transformer, TransformerConfig
+from byteps_tpu.models.transformer import init_cache
+
+STEPS = 255
+gB = 8
+
+
+def run(layers, d_model, S, d_ff=None):
+    d_ff = d_ff if d_ff is not None else 4 * d_model
+    cfg = TransformerConfig(
+        vocab_size=32000, num_layers=layers, num_heads=12, d_model=d_model,
+        d_ff=d_ff, max_seq_len=S, dtype=jnp.bfloat16)
+    model = Transformer(cfg)
+    tok0 = jnp.zeros((gB,), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), tok0[:, None])
+    variables = jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.bfloat16)
+        if jnp.issubdtype(x.dtype, jnp.floating) else x, variables)
+
+    @jax.jit
+    def decode_scan(tree, tok0):
+        caches = init_cache(cfg, gB, S)
+
+        def step(carry, pos):
+            caches, tok = carry
+            logits, caches = model.apply(tree, tok[:, None], caches, pos,
+                                         method=Transformer.decode)
+            nxt = jnp.argmax(logits[:, -1], axis=-1)
+            return (caches, nxt), ()
+
+        (caches, tok), _ = jax.lax.scan(
+            step, (caches, tok0), jnp.arange(STEPS) % S)
+        return tok
+
+    out = decode_scan(variables, tok0)
+    readback_barrier(out)
+    best = float("inf")
+    for _ in range(4):
+        t0 = time.perf_counter()
+        out = decode_scan(variables, tok0)
+        readback_barrier(out)
+        best = min(best, time.perf_counter() - t0)
+    ms = best / STEPS * 1e3
+    n_params = sum(
+        x.size for k, x in jax.tree_util.tree_flatten_with_path(
+            variables["params"])[0]
+        if "embed" not in jax.tree_util.keystr(k)
+        and "pos" not in jax.tree_util.keystr(k))
+    cache_mb = 2 * gB * S * d_model * 2 * layers / 1e6
+    wmb = n_params * 2 / 1e6
+    print(f"L={layers:2d} d={d_model:4d} S={S:4d}: {ms:.3f} ms/tok  "
+          f"weights {wmb:.0f}MB cache {cache_mb:.0f}MB  "
+          f"implied {(wmb + cache_mb) / ms:.0f} GB/s", flush=True)
+    return ms
+
+
+print("device:", jax.devices()[0].device_kind, flush=True)
+run(12, 768, 320)    # base (bench config shape)
+run(6, 768, 320)     # half the layers
+run(12, 768, 64)     # tiny cache
+run(12, 1536, 320)   # 4x block weights
+run(12, 768, 2048)   # long-context cache

@@ -1,0 +1,114 @@
+"""Microbench decode-shaped dots: bf16 XLA dot vs mixed s8 XLA dot vs a
+Pallas in-kernel-dequant dot, each inside a 255-step scan with a data
+dependence (the realistic decode regime: same weight re-read every step).
+
+Shapes: x [8, 768] @ W [768, 3072] — the MLP-up projection, decode's
+modal dot.
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+sys.path.insert(0, ".")
+from byteps_tpu.common.timing import readback_barrier
+
+M, K, N = 8, 768, 3072
+STEPS = 255
+BN = 512
+
+
+def quant_dot_kernel(x_ref, w_ref, s_ref, o_ref):
+    w = w_ref[...].astype(jnp.bfloat16)
+    acc = jnp.dot(x_ref[...], w, preferred_element_type=jnp.float32)
+    o_ref[...] = (acc * s_ref[...].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("bn",))
+def pallas_quant_dot(x, w, s, bn=BN):
+    return pl.pallas_call(
+        quant_dot_kernel,
+        grid=(N // bn,),
+        in_specs=[
+            pl.BlockSpec((M, K), lambda i: (0, 0)),
+            pl.BlockSpec((K, bn), lambda i: (0, i)),
+            pl.BlockSpec((1, bn), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((M, bn), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((M, N), jnp.bfloat16),
+    )(x, w, s)
+
+
+x0 = jax.random.normal(jax.random.PRNGKey(0), (M, K), jnp.bfloat16)
+wf = jax.random.normal(jax.random.PRNGKey(1), (K, N), jnp.float32)
+absmax = jnp.max(jnp.abs(wf), axis=0)
+scale = (absmax / 127.0)
+q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+wbf = wf.astype(jnp.bfloat16)
+srow = scale[None, :]
+
+
+L_SHORT, L_LONG = 128, 1152
+
+
+def scan_over(dot_fn, *weights):
+    def run(length):
+        @jax.jit
+        def go(x0, *weights):
+            def step(x, _):
+                y = dot_fn(x, *weights)
+                return jnp.tanh(y[:, :K]).astype(jnp.bfloat16), ()
+            out, _ = jax.lax.scan(step, x0, None, length=length)
+            return out
+        return go
+    return run, weights
+
+
+variants = {
+    "bf16 XLA dot  ": scan_over(
+        lambda x, w: jax.lax.dot_general(
+            x, w, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.bfloat16), wbf),
+    "s8 mixed dot  ": scan_over(
+        lambda x, w, s: jax.lax.dot_general(
+            x, w, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.bfloat16) * s.astype(jnp.bfloat16),
+        q, srow),
+    "pallas s8 dot ": scan_over(pallas_quant_dot, q, srow),
+}
+
+print("device:", jax.devices()[0].device_kind, flush=True)
+compiled = {}
+for name, (mk, w) in variants.items():
+    cs = mk(L_SHORT).lower(x0, *w).compile()
+    cl = mk(L_LONG).lower(x0, *w).compile()
+    readback_barrier(cs(x0, *w), cl(x0, *w))
+    compiled[name] = (cs, cl, w)
+
+# two-length differencing cancels the tunnel's fixed per-call dispatch
+# cost exactly; interleaving cancels drift
+best_s = {name: float("inf") for name in variants}
+best_l = {name: float("inf") for name in variants}
+for _ in range(6):
+    for name in variants:
+        cs, cl, w = compiled[name]
+        t0 = time.perf_counter()
+        readback_barrier(cs(x0, *w))
+        best_s[name] = min(best_s[name], time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        readback_barrier(cl(x0, *w))
+        best_l[name] = min(best_l[name], time.perf_counter() - t0)
+
+for name in variants:
+    us = (best_l[name] - best_s[name]) / (L_LONG - L_SHORT) * 1e6
+    mb = (K * N * (1 if "s8" in name else 2)) / 1e6
+    print(f"{name}: {us:7.2f} us/dot  ({mb:.1f}MB -> "
+          f"{mb / 1e3 / (us / 1e6):.0f} GB/s)  "
+          f"[fixed overhead {best_s[name]*1e3 - us*L_SHORT/1e3:.1f} ms]",
+          flush=True)

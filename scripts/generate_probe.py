@@ -1,0 +1,79 @@
+"""Time the REAL product path — inference.make_generate_fn — for bf16 and
+int8 trees, via two-N differencing (N=32 vs N=256 generate calls share
+the same prefill and dispatch cost, so the difference is pure decode).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+
+from byteps_tpu.common.timing import readback_barrier
+from byteps_tpu.inference import make_generate_fn, quantize_params
+from byteps_tpu.models import Transformer, TransformerConfig
+
+gB, gT = 8, 256
+N_S, N_L = 32, 256
+cfg = TransformerConfig(vocab_size=32000, num_layers=12, num_heads=12,
+                        d_model=768, d_ff=3072, max_seq_len=gT + N_L,
+                        dtype=jnp.bfloat16)
+model = Transformer(cfg)
+prompt = jax.random.randint(jax.random.PRNGKey(11), (gB, gT), 0,
+                            cfg.vocab_size)
+variables = model.init(jax.random.PRNGKey(12), prompt)
+rng = jax.random.PRNGKey(0)
+
+bf16_tree = jax.tree_util.tree_map(
+    lambda x: x.astype(jnp.bfloat16)
+    if jnp.issubdtype(x.dtype, jnp.floating) else x, variables)
+q_tree = {"params": quantize_params(variables["params"])}
+
+CL = gT + N_L  # same cache geometry for both program lengths
+fn_s = make_generate_fn(model, N_S, temperature=0, cache_len=CL)
+fn_l = make_generate_fn(model, N_L, temperature=0, cache_len=CL)
+fn_s_q = make_generate_fn(model, N_S, temperature=0, kv_quant=True,
+                          cache_len=CL)
+fn_l_q = make_generate_fn(model, N_L, temperature=0, kv_quant=True,
+                          cache_len=CL)
+
+variants = [("bf16        ", bf16_tree, fn_s, fn_l),
+            ("int8 w      ", q_tree, fn_s, fn_l),
+            ("int8 w+cache", q_tree, fn_s_q, fn_l_q)]
+print("device:", jax.devices()[0].device_kind, flush=True)
+for name, tree, fs, fl in variants:
+    readback_barrier(fs(tree, prompt, rng), fl(tree, prompt, rng))
+
+# adjacent S/L pairs: the short and long call see the same drift regime,
+# so their difference carries only per-step device time; the median over
+# rounds rejects dispatch outliers
+diffs = {n: [] for n, _, _, _ in variants}
+for _ in range(10):
+    for name, tree, fs, fl in variants:
+        t0 = time.perf_counter()
+        readback_barrier(fs(tree, prompt, rng))
+        ts = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        readback_barrier(fl(tree, prompt, rng))
+        tl = time.perf_counter() - t0
+        diffs[name].append(tl - ts)
+
+base = None
+for name, _, _, _ in variants:
+    d = sorted(diffs[name])
+    n = len(d)
+    med = d[n // 2] if n % 2 else 0.5 * (d[n // 2 - 1] + d[n // 2])
+    ms = med / (N_L - N_S) * 1e3
+    spread = (d[-2] - d[1]) / (N_L - N_S) * 1e3
+    tps = gB / (ms / 1e3)
+    note = ""
+    if name.startswith("bf16"):
+        base = ms
+    elif base:
+        note = f"  speedup vs bf16 {base / ms:.2f}x"
+    print(f"{name}: {ms:.3f} ms/token (spread {spread:.3f}) -> "
+          f"{tps:.0f} tok/s{note}", flush=True)

@@ -1,0 +1,73 @@
+"""Raw HBM read bandwidth per dtype on this chip, via two-size
+differencing (cancels the ~85-100 ms tunneled dispatch cost).
+
+Each variant reduces a big array to a scalar; bytes/dt between the large
+and small array gives the stream rate for that dtype's loads.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+from byteps_tpu.common.timing import readback_barrier
+
+BIG = 384 << 20   # bytes
+SMALL = 64 << 20
+
+
+def make(dtype, nbytes, loops):
+    n = nbytes // jnp.dtype(dtype).itemsize
+    if dtype == jnp.int8:
+        x = jnp.ones((n,), jnp.int8)
+    else:
+        x = jnp.ones((n,), dtype)
+    acc_dt = jnp.int32 if dtype == jnp.int8 else jnp.float32
+
+    half = n // 2
+
+    @jax.jit
+    def reduce(x):
+        # each iteration reads an alternating aligned half-window via a
+        # loop-varying dynamic_slice — XLA cannot CSE or hoist it, so the
+        # bytes are genuinely re-streamed every iteration
+        def body(i, acc):
+            off = (i % 2) * half
+            chunk = jax.lax.dynamic_slice(x, (off,), (half,))
+            return acc + jnp.sum(chunk, dtype=acc_dt)
+
+        return jax.lax.fori_loop(0, loops, body, acc_dt(0))
+
+    return x, reduce
+
+
+LOOPS_B, LOOPS_S = 48, 8
+variants = {}
+for name, dt in [("s8 ", jnp.int8), ("bf16", jnp.bfloat16),
+                 ("f32 ", jnp.float32)]:
+    xb, fb = make(dt, BIG, LOOPS_B)
+    xs, fs = make(dt, BIG, LOOPS_S)
+    readback_barrier(fb(xb), fs(xb))
+    variants[name] = (xb, fb, xb, fs)
+
+print("device:", jax.devices()[0].device_kind, flush=True)
+best_b = {n: float("inf") for n in variants}
+best_s = {n: float("inf") for n in variants}
+for _ in range(6):
+    for n, (xb, fb, xs, fs) in variants.items():
+        t0 = time.perf_counter()
+        readback_barrier(fb(xb))
+        best_b[n] = min(best_b[n], time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        readback_barrier(fs(xs))
+        best_s[n] = min(best_s[n], time.perf_counter() - t0)
+
+for n in variants:
+    dt = best_b[n] - best_s[n]
+    gbps = (BIG // 2) * (LOOPS_B - LOOPS_S) / dt / 1e9
+    print(f"{n}: {gbps:.0f} GB/s  (48-loop {best_b[n]*1e3:.1f}ms 8-loop "
+          f"{best_s[n]*1e3:.1f}ms)", flush=True)

@@ -246,3 +246,47 @@ def test_cache_len_guard():
     c2 = init_cache(noncausal, 2, 32)
     with pytest.raises(ValueError):
         m2.apply(v2, tokens, c2, 0, method=Transformer.decode)
+
+
+def test_classify_divergence_none_tie_real():
+    """The divergence classifier (VERDICT r3 #8): identical decodes ->
+    none; a second-best-token flip within the tie threshold -> tie; an
+    injected cache-bug-style wrong token (clearly lower logit) -> real."""
+    import numpy as np
+
+    from byteps_tpu.inference import classify_divergence, generate
+
+    cfg, model, _, variables = _tiny_model()
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 6), 0,
+                                cfg.vocab_size)
+    out = generate(model, variables, prompt, 8, temperature=0)
+    toks = np.asarray(out["tokens"])
+
+    res = classify_divergence(model, variables, prompt, toks, toks)
+    assert res["divergence"] == "none"
+
+    # teacher-force to find the runner-up token at a mid position
+    full = jnp.concatenate([prompt, jnp.asarray(toks)], axis=1)
+    logits = np.asarray(model.apply(variables, full), np.float32)
+    T = prompt.shape[1]
+    d = 4
+    row = logits[0, T + d - 1]
+    order = np.argsort(row)[::-1]
+    runner_up = int(order[1] if order[0] == toks[0, d] else order[0])
+    worst = int(order[-1])
+
+    tie_b = toks.copy()
+    tie_b[0, d] = runner_up
+    # generous threshold -> the runner-up flip classifies as a tie
+    res = classify_divergence(model, variables, prompt, toks, tie_b,
+                              tie_rtol=10.0)
+    assert res["divergence"] == "tie" and res["first_div_pos"] == d
+
+    bug_b = toks.copy()
+    bug_b[0, d] = worst
+    # an injected wrong token (cache-bug analog) must classify as real
+    res = classify_divergence(model, variables, prompt, toks, bug_b,
+                              tie_rtol=0.0, tie_atol=1e-6)
+    assert res["divergence"] == "real"
+    assert res["first_div_pos"] == d
+    assert res["delta_logit"] > 0  # path A's token scores higher
