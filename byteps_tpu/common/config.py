@@ -88,6 +88,12 @@ class Config:
     debug_sample_tensor: str = ""
     trace_path: str = ""  # chrome-trace output ("" = disabled)
 
+    # --- server-tier profiling (reference docs/timeline.md:1-30,
+    # BYTEPS_SERVER_ENABLE_PROFILE) ---------------------------------------
+    server_enable_profile: bool = False
+    server_profile_output_path: str = "server_profile.json"
+    server_key_to_profile: Optional[int] = None  # None = all keys
+
     # --- TPU-specific ----------------------------------------------------
     wire_dtype: str = ""  # "" (no compression) | "bf16" | "fp16"
     mesh_shape: str = ""  # e.g. "dp=8" or "dcn=2,dp=4"; "" = auto
@@ -109,6 +115,10 @@ class Config:
             log_level=_env_str("BYTEPS_LOG_LEVEL", "WARNING"),
             debug_sample_tensor=_env_str("BYTEPS_DEBUG_SAMPLE_TENSOR", ""),
             trace_path=_env_str("BYTEPS_TRACE_PATH", ""),
+            server_enable_profile=_env_bool("BYTEPS_SERVER_ENABLE_PROFILE"),
+            server_profile_output_path=_env_str(
+                "BYTEPS_SERVER_PROFILE_OUTPUT_PATH", "server_profile.json"),
+            server_key_to_profile=_env_opt_int("BYTEPS_SERVER_KEY_TO_PROFILE"),
             wire_dtype=_env_str("BYTEPS_WIRE_DTYPE", ""),
             mesh_shape=_env_str("BYTEPS_MESH_SHAPE", ""),
         )

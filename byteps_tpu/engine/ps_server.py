@@ -41,6 +41,7 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import List, Optional
 
 import numpy as np
@@ -125,9 +126,89 @@ def _decode(sock: socket.socket):
 # -------------------------------------------------------------------- server
 
 
+_PROFILED_OPS = {OP_PUSH: "push", OP_PULL: "pull", OP_PUSH_PULL: "push_pull"}
+
+
+class ServerProfiler:
+    """Per-key request timeline on the PS tier — the reference's
+    straggler-hunting tool (``BYTEPS_SERVER_ENABLE_PROFILE``,
+    /root/reference/docs/timeline.md:1-30): each push/pull request emits
+    chrome-trace ``B``/``E`` events spanning arrival to completion, with
+    the tensor's declared key as pid/tid and the requesting peer in the
+    event name — load ``server_profile.json`` in chrome://tracing and a
+    slow shard or a consistently-late worker is visible per key.
+
+    Env knobs (byteps-compatible): ``BYTEPS_SERVER_ENABLE_PROFILE=1``,
+    ``BYTEPS_SERVER_PROFILE_OUTPUT_PATH=/path.json``,
+    ``BYTEPS_SERVER_KEY_TO_PROFILE=<key>`` (restrict to one key).
+    """
+
+    _AUTOFLUSH = 4096  # events buffered before an automatic flush
+
+    def __init__(self, path: str, key_filter: Optional[int] = None):
+        self._path = path
+        self._key_filter = key_filter
+        self._events: List[dict] = []
+        self._lock = threading.Lock()
+        self._written = False  # file has an opening '[' + >=1 event
+
+    def record(self, op: int, name: str, peer: str, t_begin: float,
+               t_end: float) -> None:
+        opname = _PROFILED_OPS.get(op)
+        if opname is None:
+            return
+        key = name_key(name)
+        if self._key_filter is not None and key != self._key_filter:
+            return
+        ev = f"{opname}-{peer}"
+        with self._lock:
+            self._events.append({"name": ev, "ph": "B", "pid": key,
+                                 "tid": key, "ts": int(t_begin * 1e6)})
+            self._events.append({"name": ev, "ph": "E", "pid": key,
+                                 "tid": key, "ts": int(t_end * 1e6)})
+            if len(self._events) >= self._AUTOFLUSH:
+                self._drain_locked()
+
+    def _drain_locked(self) -> None:
+        """Append buffered events to the file (caller holds the lock).
+        The buffer is drained — flushes are O(new events), never a
+        rewrite of history — and the file is a chrome-trace JSON array
+        kept loadable mid-run by the viewer's documented leniency about
+        a missing closing bracket; ``close()`` terminates it properly."""
+        import json
+
+        events, self._events = self._events, []
+        if not events:
+            return
+        mode = "a" if self._written else "w"
+        with open(self._path, mode) as f:
+            for ev in events:
+                f.write(("[\n" if not self._written else ",\n")
+                        + json.dumps(ev))
+                self._written = True
+        bps_log.debug("ps_server profiler: +%d events -> %s",
+                      len(events), self._path)
+
+    def flush(self) -> None:
+        with self._lock:
+            self._drain_locked()
+
+    def close(self) -> None:
+        """Drain and terminate the JSON array (valid strict JSON)."""
+        with self._lock:
+            self._drain_locked()
+            if self._written:
+                with open(self._path, "a") as f:
+                    f.write("\n]\n")
+                self._written = False
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):  # one connection, many requests
         store: AsyncParameterServer = self.server.store  # type: ignore[attr-defined]
+        profiler: Optional[ServerProfiler] = getattr(
+            self.server, "profiler", None)
+        peer = "%s:%s" % self.client_address[:2]
         sock = self.request
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         try:
@@ -136,6 +217,7 @@ class _Handler(socketserver.BaseRequestHandler):
                     op, name, arr, _ = _decode(sock)
                 except ConnectionError:
                     return
+                t_begin = time.time()
                 # store-level errors (e.g. pull of an un-init'd name) reply
                 # status=1 and keep the connection alive — only wire-level
                 # failures tear it down
@@ -164,6 +246,8 @@ class _Handler(socketserver.BaseRequestHandler):
                     reply = _encode(
                         1, "", None, f"{type(e).__name__}: {e}".encode()
                     )
+                if profiler is not None:
+                    profiler.record(op, name, peer, t_begin, time.time())
                 sock.sendall(reply)
         except Exception as e:  # pragma: no cover - connection teardown races
             bps_log.debug("ps_server handler exit: %s", e)
@@ -176,6 +260,20 @@ class PSServer(socketserver.ThreadingTCPServer):
     def __init__(self, addr, use_native: bool = True):
         super().__init__(addr, _Handler)
         self.store = AsyncParameterServer(use_native=use_native)
+        from ..common.config import get_config
+
+        cfg = get_config()
+        self.profiler: Optional[ServerProfiler] = None
+        if cfg.server_enable_profile:
+            self.profiler = ServerProfiler(
+                cfg.server_profile_output_path, cfg.server_key_to_profile)
+            bps_log.info("ps_server: per-key profiling on -> %s",
+                         cfg.server_profile_output_path)
+
+    def server_close(self):
+        if self.profiler is not None:
+            self.profiler.close()
+        super().server_close()
 
 
 def serve(port: int, host: str = "0.0.0.0", use_native: bool = True,

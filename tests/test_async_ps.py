@@ -392,3 +392,45 @@ def test_trainer_pipelined_async_no_trainloop_device_get(monkeypatch):
         trainer.close()  # stops the exchange thread (frees the snapshot)
     finally:
         reset_async_store()
+
+
+def test_ps_server_profile_timeline(tmp_path, monkeypatch):
+    """BYTEPS_SERVER_ENABLE_PROFILE writes a chrome-trace of per-key
+    push/pull B/E spans on the server tier (reference docs/timeline.md:
+    the straggler-hunting tool the worker-side tracer cannot provide)."""
+    import json
+
+    from byteps_tpu.common import config as bps_config
+    from byteps_tpu.common.context import name_key
+    from byteps_tpu.engine import ps_server
+
+    out = tmp_path / "server_profile.json"
+    monkeypatch.setenv("BYTEPS_SERVER_ENABLE_PROFILE", "1")
+    monkeypatch.setenv("BYTEPS_SERVER_PROFILE_OUTPUT_PATH", str(out))
+    bps_config.reset_config()
+    try:
+        srv, thread = ps_server.serve(0, host="127.0.0.1",
+                                      use_native=False, in_thread=True)
+        addr = "127.0.0.1:%d" % srv.server_address[1]
+        store = ps_server.RemoteStore([addr])
+        store.init_tensor("w", np.zeros(4, np.float32))
+        store.push_pull("w", np.ones(4, np.float32))
+        store.pull("w")
+        store.close()
+        srv.shutdown()
+        srv.server_close()  # flushes the profile
+        thread.join(timeout=5)
+
+        events = json.loads(out.read_text())
+        names = {e["name"].split("-", 1)[0] for e in events}
+        assert "push_pull" in names and "pull" in names
+        # init is not a data-plane request: not profiled
+        assert "init" not in names
+        key = name_key("w")
+        assert all(e["pid"] == key and e["tid"] == key for e in events)
+        # every span is a B followed by an E with ts_E >= ts_B
+        assert [e["ph"] for e in events] == ["B", "E"] * (len(events) // 2)
+        for b, e in zip(events[::2], events[1::2]):
+            assert e["ts"] >= b["ts"] and b["name"] == e["name"]
+    finally:
+        bps_config.reset_config()
