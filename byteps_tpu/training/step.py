@@ -72,6 +72,14 @@ def make_data_parallel_step(
     all workers via the bucketed scheduled push_pull; BatchNorm normalizes
     per-replica (torchvision semantics) while running stats are averaged
     across replicas so the state stays replicated.
+
+    .. note:: At ``world == 1`` (with ``backward_passes_per_step == 1``)
+       the DistributedOptimizer wrapper is dropped entirely — including
+       any ``compression`` passed — matching the reference's ``size()==1``
+       short-circuit.  This changes the ``opt_state`` pytree nesting by
+       one chain-tuple level, so **checkpoints do not transfer between
+       world sizes**; a passed compression triggers a one-time warning
+       since it will not be applied.
     """
     axes = tuple(axes)
     world = 1
@@ -82,9 +90,15 @@ def make_data_parallel_step(
         # when size()==1): the push_pull wrapper is already a traced no-op
         # at world==1, but its chain nesting in opt_state costs measurable
         # per-call dispatch on small models (~80 us/step through the
-        # tunneled runtime) — drop the wrapper entirely.  Note: opt_state
-        # nesting then differs from the multi-worker layout by the chain
-        # tuple level; checkpoints do not transfer between world sizes.
+        # tunneled runtime) — drop the wrapper entirely.
+        if compression is not Compression.none:
+            from ..common.logging import get_logger
+
+            get_logger().warning(
+                "make_data_parallel_step: world size is 1 — the "
+                "compression=%s wrapper is dropped (nothing crosses the "
+                "wire); it will engage on multi-device meshes",
+                getattr(compression, "__name__", compression))
         tx = optimizer
     else:
         tx = DistributedOptimizer(

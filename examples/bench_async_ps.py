@@ -50,27 +50,34 @@ def main():
                    for i in range(args.workers)]
         worst_step = [0.0] * args.workers
         final = [None] * args.workers
+        errors = [None] * args.workers
 
         def work(idx, w):
-            params = np.zeros(args.dim, np.float32)
-            for it in range(args.steps):
-                t0 = time.perf_counter()
-                params = params - lr * (params - target)   # local step
-                if (it + 1) % args.interval == 0:
-                    if mode == "sync":
-                        pulled = w.push_pull({"w": jnp.asarray(params)})
-                        params = np.asarray(pulled["w"]).copy()
-                    else:
-                        if w.exchange_in_flight():
-                            pulled, sub = w.take_result()
-                            params = params + (pulled["w"] - sub["w"])
-                        w.begin_push_pull({"w": jnp.asarray(params)})
-                worst_step[idx] = max(worst_step[idx],
-                                      time.perf_counter() - t0)
-            if mode != "sync" and w.exchange_in_flight():
-                pulled, sub = w.take_result()
-                params = params + (pulled["w"] - sub["w"])
-            final[idx] = params
+            # any exception is captured and re-raised on the main thread:
+            # a dead worker must fail the benchmark loudly, not surface
+            # later as `None - target` TypeError noise
+            try:
+                params = np.zeros(args.dim, np.float32)
+                for it in range(args.steps):
+                    t0 = time.perf_counter()
+                    params = params - lr * (params - target)   # local step
+                    if (it + 1) % args.interval == 0:
+                        if mode == "sync":
+                            pulled = w.push_pull({"w": jnp.asarray(params)})
+                            params = np.asarray(pulled["w"]).copy()
+                        else:
+                            if w.exchange_in_flight():
+                                pulled, sub = w.take_result()
+                                params = params + (pulled["w"] - sub["w"])
+                            w.begin_push_pull({"w": jnp.asarray(params)})
+                    worst_step[idx] = max(worst_step[idx],
+                                          time.perf_counter() - t0)
+                if mode != "sync" and w.exchange_in_flight():
+                    pulled, sub = w.take_result()
+                    params = params + (pulled["w"] - sub["w"])
+                final[idx] = params
+            except BaseException as exc:  # noqa: BLE001
+                errors[idx] = exc
 
         t0 = time.perf_counter()
         threads = [threading.Thread(target=work, args=(i, w))
@@ -79,6 +86,10 @@ def main():
             t.start()
         for t in threads:
             t.join()
+        for idx, exc in enumerate(errors):
+            if exc is not None:
+                raise RuntimeError(
+                    f"worker thread {idx} died ({mode} mode)") from exc
         wall = time.perf_counter() - t0
         err = max(float(np.abs(f - target).max()) for f in final)
         return {
