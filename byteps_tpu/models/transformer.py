@@ -43,6 +43,15 @@ class TransformerConfig:
     vocab_size: int = 32000
     num_layers: int = 4
     num_heads: int = 8
+    # GQA/MQA: number of shared K/V heads (None = num_heads, i.e. MHA).
+    # Every group of num_heads/num_kv_heads query heads reads one K/V
+    # head — the KV cache shrinks by the same factor, which is *the*
+    # decode-bandwidth lever at long context (the cache stream scales
+    # with B*T*kv_heads while weights are constant).  Flash attention
+    # consumes grouped K/V natively (ops/flash_attention.py _gqa_group);
+    # cached decode runs grouped mixed dots without materializing the
+    # head repeat; sp/ring paths broadcast K/V to full heads in-register.
+    num_kv_heads: Optional[int] = None
     d_model: int = 512
     d_ff: int = 2048
     max_seq_len: int = 2048
@@ -64,6 +73,16 @@ class TransformerConfig:
     sp_axis: str = "sp"
     tp_axis: str = "tp"
     mesh: Optional[Mesh] = None
+
+    @property
+    def kv_heads(self) -> int:
+        kv = self.num_kv_heads
+        if kv is None:
+            return self.num_heads
+        if kv < 1 or self.num_heads % kv:
+            raise ValueError(
+                f"num_kv_heads {kv} must divide num_heads {self.num_heads}")
+        return kv
 
     def partition(self, init, spec):
         """Wrap an initializer with tp-sharding metadata — only when this
@@ -217,53 +236,88 @@ def _quantize_kv(x):
     return q.astype(jnp.int8), scale
 
 
+def _group_q(q, KV):
+    """``[B, tq, H, D] -> [B, KV, G*tq, D]`` with ``G = H // KV``: query
+    heads fold onto their shared K/V head's batch row (group-major,
+    query-position-minor), so cached GQA attention is two plain batched
+    dots against the *un-repeated* cache — the whole point of GQA is
+    that the cache streams KV heads' bytes, and a materialized
+    ``jnp.repeat`` would hand that win straight back."""
+    B, tq, H, D = q.shape
+    G = H // KV
+    return (q.reshape(B, tq, KV, G, D).transpose(0, 2, 3, 1, 4)
+            .reshape(B, KV, G * tq, D))
+
+
+def _ungroup_o(o, tq):
+    """Inverse of ``_group_q`` on the attention output:
+    ``[B, KV, G*tq, D] -> [B, tq, KV*G, D]``."""
+    B, KV, GT, D = o.shape
+    G = GT // tq
+    return (o.reshape(B, KV, G, tq, D).transpose(0, 3, 1, 2, 4)
+            .reshape(B, tq, KV * G, D))
+
+
+def _grouped_mask(S, tq, G, pos, window):
+    """Causal (+ optional sliding-window) keep-mask ``[1, 1, G*tq, S]``
+    matching ``_group_q``'s row order (each query position appears once
+    per group, at the same absolute offset)."""
+    kidx = jnp.arange(S)[None, None, None, :]
+    qidx = jnp.tile(pos + jnp.arange(tq), G)[None, None, :, None]
+    mask = kidx <= qidx
+    if window is not None:
+        mask = mask & (kidx > qidx - window)
+    return mask
+
+
 def _cached_attention_q8(q, ck, ck_scale, cv, cv_scale, pos, window=None):
     """Dense cached attention against an int8-quantized KV cache
-    (``ck/cv [B, S, H, D]`` s8 with per-(position, head) f32 scales).
+    (``ck/cv [B, S, KV, D]`` s8 with per-(position, head) f32 scales);
+    ``KV`` may be fewer heads than q carries (GQA/MQA).
 
     The dequant never materializes: K's scale commutes out of the QK^T
     contraction (it is constant along D), so the score dot runs mixed
-    ``bf16 x s8`` and the scale multiplies the [B, H, tq, S] scores;
+    ``bf16 x s8`` and the scale multiplies the [B, KV, G*tq, S] scores;
     V's scale is constant along the *contracted* S axis, so it folds
     into the probabilities before the mixed PV dot — the cache streams
     s8 bytes end to end, halving decode's second-largest HBM read.
     """
-    scale = q.shape[-1] ** -0.5
-    # scores[b,h,q,k] = sum_d q[b,q,h,d] * ck[b,k,h,d]  (mixed s8 dot).
+    B, tq, H, D = q.shape
+    KV = ck.shape[2]
+    scale = D ** -0.5
+    qg = _group_q((q * scale).astype(q.dtype), KV)
+    # scores[b,c,r,k] = sum_d qg[b,c,r,d] * ck[b,k,c,d]  (mixed s8 dot).
     # preferred_element_type MUST stay the operand dtype: asking the
     # mixed dot for an f32 output makes XLA convert the whole s8 cache
     # to a materialized f32 temp every step (observed r4) — the dot
-    # accumulates f32 internally either way, and the [B, H, tq, S]
+    # accumulates f32 internally either way, and the [B, KV, G*tq, S]
     # scores are upcast right after, which is cheap.
     scores = jax.lax.dot_general(
-        (q * scale).astype(q.dtype), ck,
-        (((3,), (3,)), ((0, 2), (0, 2))),
-        preferred_element_type=q.dtype)                # [B, H, tq, S]
+        qg, ck, (((3,), (3,)), ((0, 1), (0, 2))),
+        preferred_element_type=q.dtype)            # [B, KV, G*tq, S]
     scores = (scores.astype(jnp.float32)
               * jnp.transpose(ck_scale, (0, 2, 1))[:, :, None, :])
-    kidx = jnp.arange(ck.shape[1])[None, None, None, :]
-    qidx = (pos + jnp.arange(q.shape[1]))[None, None, :, None]
-    mask = kidx <= qidx
-    if window is not None:
-        mask = mask & (kidx > qidx - window)
+    mask = _grouped_mask(ck.shape[1], tq, H // KV, pos, window)
     scores = jnp.where(mask, scores, jnp.float32(-1e30))
     probs = jax.nn.softmax(scores, axis=-1)
     probs = (probs
              * jnp.transpose(cv_scale, (0, 2, 1))[:, :, None, :]
              ).astype(q.dtype)
-    # out[b,h,q,d] = sum_k probs[b,h,q,k] * cv[b,k,h,d]  (mixed s8 dot;
+    # out[b,c,r,d] = sum_k probs[b,c,r,k] * cv[b,k,c,d]  (mixed s8 dot;
     # same rule — output at operand dtype so the s8 cache is consumed
     # directly)
     out = jax.lax.dot_general(
         probs, cv, (((3,), (1,)), ((0, 1), (0, 2))),
-        preferred_element_type=q.dtype)                # [B, H, tq, D]
-    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+        preferred_element_type=q.dtype)            # [B, KV, G*tq, D]
+    return _ungroup_o(out, tq).astype(q.dtype)
 
 
 def _cached_attention(q, ck, cv, pos, window=None):
     """Dense attention of ``q [B, tq, H, D]`` (absolute offset ``pos``)
-    against a KV cache ``ck/cv [B, S, H, D]`` whose slots beyond
-    ``pos + tq`` are unwritten.
+    against a KV cache ``ck/cv [B, S, KV, D]`` whose slots beyond
+    ``pos + tq`` are unwritten; ``KV`` may be fewer heads than q
+    carries (GQA/MQA — each group of H/KV query heads reads one cache
+    head, via ``_group_q``'s fold rather than a materialized repeat).
 
     The causal mask ``key_j <= pos + i`` both enforces autoregressive
     order and excludes the unwritten tail, so one static-shape program
@@ -272,18 +326,19 @@ def _cached_attention(q, ck, cv, pos, window=None):
     scores are the right call here: decode is HBM-bound on the cache
     read anyway, and tq is tiny.
     """
-    scale = q.shape[-1] ** -0.5
-    scores = jnp.einsum(
-        "bqhd,bkhd->bhqk", q * scale, ck,
-        preferred_element_type=jnp.float32)
-    kidx = jnp.arange(ck.shape[1])[None, None, None, :]
-    qidx = (pos + jnp.arange(q.shape[1]))[None, None, :, None]
-    mask = kidx <= qidx
-    if window is not None:
-        mask = mask & (kidx > qidx - window)
+    B, tq, H, D = q.shape
+    KV = ck.shape[2]
+    scale = D ** -0.5
+    qg = _group_q(q * scale, KV)
+    scores = jax.lax.dot_general(
+        qg, ck, (((3,), (3,)), ((0, 1), (0, 2))),
+        preferred_element_type=jnp.float32)        # [B, KV, G*tq, S]
+    mask = _grouped_mask(ck.shape[1], tq, H // KV, pos, window)
     scores = jnp.where(mask, scores, jnp.float32(-1e30))
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, cv)
+    out = jax.lax.dot_general(
+        probs, cv, (((3,), (1,)), ((0, 1), (0, 2))))
+    return _ungroup_o(out, tq)
 
 
 class Attention(nn.Module):
@@ -293,6 +348,7 @@ class Attention(nn.Module):
     def __call__(self, x, key_mask=None, cache=None, pos=None):
         cfg = self.cfg
         H, D = cfg.num_heads, cfg.d_model // cfg.num_heads
+        KV = cfg.kv_heads
         proj = partial(
             QuantDense, dtype=cfg.dtype, use_bias=cfg.use_bias,
             kernel_init=cfg.partition(
@@ -300,8 +356,19 @@ class Attention(nn.Module):
             ),
         )
         q = proj(features=(H, D), name="q")(x)
-        k = proj(features=(H, D), name="k")(x)
-        v = proj(features=(H, D), name="v")(x)
+        kv_proj = proj
+        if (cfg.mesh is not None and cfg.tp_axis in cfg.mesh.axis_names
+                and KV % cfg.mesh.shape[cfg.tp_axis]):
+            # MQA/small-KV under tensor parallelism: the kv head axis
+            # (KV entries) is not divisible by the tp size, so sharding
+            # it would fail deep inside GSPMD.  Replicate the k/v
+            # kernels instead (the standard Megatron MQA treatment —
+            # they are num_heads/KV-fold smaller than q's anyway).
+            kv_proj = partial(QuantDense, dtype=cfg.dtype,
+                              use_bias=cfg.use_bias,
+                              kernel_init=nn.initializers.xavier_uniform())
+        k = kv_proj(features=(KV, D), name="k")(x)
+        v = kv_proj(features=(KV, D), name="v")(x)
         o_proj = QuantDense(
             features=cfg.d_model, in_axes=2, dtype=cfg.dtype, name="o",
             use_bias=cfg.use_bias,
@@ -375,6 +442,15 @@ class Attention(nn.Module):
                 out = _cached_attention(q, ck, cv, pos,
                                         window=cfg.attn_window)
             return o_proj(out), new_cache
+        if KV != H and not (cfg.attn_impl == "flash" and not cfg.has_sp):
+            # GQA on the non-flash training paths (local / ring /
+            # ulysses): broadcast K/V to full heads in-register — the
+            # repeat is a fused broadcast under XLA, and these paths
+            # have no cache whose bytes the grouping could save.  The
+            # flash kernel instead consumes grouped K/V natively
+            # (ops/flash_attention.py _gqa_group).
+            k = jnp.repeat(k, H // KV, axis=2)
+            v = jnp.repeat(v, H // KV, axis=2)
         if key_mask is not None:
             if cfg.attn_impl == "flash" and not cfg.has_sp:
                 # padding mask rides the flash kernel's segment ids (pads
@@ -538,9 +614,12 @@ class Transformer(nn.Module):
 
 def init_cache(cfg: TransformerConfig, batch_size: int, max_len: int,
                quantized: bool = False):
-    """Zeroed per-layer KV caches ``[B, max_len, H, D]`` for
+    """Zeroed per-layer KV caches ``[B, max_len, kv_heads, D]`` for
     ``Transformer.decode``.  ``max_len`` must cover prompt + new tokens
-    and stay within ``cfg.max_seq_len`` (position embeddings).
+    and stay within ``cfg.max_seq_len`` (position embeddings).  Under
+    GQA (``cfg.num_kv_heads < num_heads``) the cache carries only the
+    shared K/V heads — a num_heads/num_kv_heads shrink of decode's
+    second-largest HBM stream.
 
     ``quantized=True`` builds an int8 cache (s8 K/V plus f32
     per-(position, head) scales): half the HBM bytes per decode step,
@@ -550,8 +629,8 @@ def init_cache(cfg: TransformerConfig, batch_size: int, max_len: int,
     if max_len > cfg.max_seq_len:
         raise ValueError(
             f"cache max_len {max_len} exceeds max_seq_len {cfg.max_seq_len}")
-    H, D = cfg.num_heads, cfg.d_model // cfg.num_heads
-    shape = (batch_size, max_len, H, D)
+    KV, D = cfg.kv_heads, cfg.d_model // cfg.num_heads
+    shape = (batch_size, max_len, KV, D)
     if quantized:
         return tuple(
             {"k": jnp.zeros(shape, jnp.int8),
