@@ -1,0 +1,72 @@
+"""Block-size sweep for flash attention at D=128 (VERDICT r3 #6).
+
+Times fwd+bwd (the bench workload: sum-of-output loss, grads wrt q/k/v)
+for a grid of (block_q, block_k) at B=4 T=4096 H=8 D=128 causal bf16,
+via repeated-call best-of timing with a readback barrier.  Reports
+nominal MFU per config against the v5e bf16 peak.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+
+from byteps_tpu.common.timing import readback_barrier
+from byteps_tpu.ops.flash_attention import flash_attention
+
+B, T, H, D = 4, 4096, 8, 128
+ks = jax.random.split(jax.random.PRNGKey(5), 3)
+q, k, v = (jax.random.normal(kk, (B, T, H, D), jnp.bfloat16) for kk in ks)
+
+FLOPS = 3.5 * (2 * 2 * B * H * T * T * D * 0.5)
+PEAK = 197e12
+
+grid = [(bq, bk)
+        for bq in (256, 512, 1024, 2048)
+        for bk in (256, 512, 1024, 2048)]
+
+results = {}
+fns = {}
+for bq, bk in grid:
+    def loss(q, k, v, bq=bq, bk=bk):
+        return jnp.sum(flash_attention(q, k, v, True, block_q=bq,
+                                       block_k=bk).astype(jnp.float32))
+
+    fns[(bq, bk)] = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
+
+CHUNK = 10  # sequential calls per timed chunk: host dispatch pipelines
+            # behind device execution; one readback (in-order queue) ends it
+
+print("device:", jax.devices()[0].device_kind, flush=True)
+for key, fn in fns.items():
+    try:
+        readback_barrier(fn(q, k, v))
+        results[key] = float("inf")
+    except Exception as e:
+        print(f"bq={key[0]} bk={key[1]}: FAILED {type(e).__name__}",
+              flush=True)
+
+for _ in range(5):
+    for key in list(results):
+        fn = fns[key]
+        t0 = time.perf_counter()
+        for _i in range(CHUNK):
+            out = fn(q, k, v)
+        readback_barrier(out)
+        results[key] = min(results[key],
+                           (time.perf_counter() - t0) / CHUNK)
+
+if not results:
+    sys.exit("flash D=128 sweep: every (block_q, block_k) config failed "
+             "to compile — nothing to rank (see FAILED lines above)")
+best = min(results, key=results.get)
+for key in sorted(results):
+    t = results[key]
+    mark = "  <-- best" if key == best else ""
+    print(f"bq={key[0]:4d} bk={key[1]:4d}: {t*1e3:7.2f} ms  "
+          f"MFU {FLOPS / t / PEAK:.4f}{mark}", flush=True)
