@@ -92,12 +92,25 @@ class ResNet(nn.Module):
     dtype: Any = jnp.float32
     act: Callable = nn.relu
     axis_name: Any = None  # set to sync BN stats across a mesh axis
+    # dtype of BN scale/bias and running stats (None = fp32, the safe
+    # default).  bf16 halves the BN state stream and drops the
+    # fp32<->bf16 converts around every BN (scripts/resnet_bn_dtype_ab.py
+    # measures what that buys on the bench chip — docs/performance.md).
+    # CAVEAT: flax stores stats in fp32 unless force_float32_reductions
+    # is off, so bf16 here also computes the batch mean/var reductions
+    # in bf16 — over ~800k elements at stage 1 that costs real variance
+    # precision; an accuracy experiment, not a free lunch.
+    norm_param_dtype: Any = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         conv = functools.partial(
             nn.Conv, use_bias=False, dtype=self.dtype, padding="SAME"
         )
+        norm_kw = {}
+        if self.norm_param_dtype is not None:
+            norm_kw = dict(param_dtype=self.norm_param_dtype,
+                           force_float32_reductions=False)
         norm = functools.partial(
             nn.BatchNorm,
             use_running_average=not train,
@@ -105,6 +118,7 @@ class ResNet(nn.Module):
             epsilon=1e-5,
             dtype=self.dtype,
             axis_name=self.axis_name,
+            **norm_kw,
         )
         x = x.astype(self.dtype)
         x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)

@@ -1,0 +1,70 @@
+"""A/B: ResNet50 bf16 train step with fp32 vs bf16 BatchNorm state
+(VERDICT r3 #10 — the one cheap lever left on the 0.24-MFU thread).
+
+The model is already NHWC (TPU-native); the remaining structural
+suspect is the fp32 BN state: every BN layer reads fp32 scale/bias +
+running stats and converts around the bf16 compute.  ``norm_param_dtype
+= bf16`` (models/resnet.py) removes those converts and halves the BN
+state stream.  This script times both variants with the bench harness's
+interleaved-pair estimator on the real chip and prints the ratio —
+whatever it says goes in docs/performance.md and closes the thread.
+"""
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench  # noqa: E402
+from byteps_tpu.models import ResNet50  # noqa: E402
+from byteps_tpu.training import (  # noqa: E402
+    classification_loss_fn,
+    make_data_parallel_step,
+)
+from byteps_tpu.training import shard_batch  # noqa: E402
+
+
+def build(norm_param_dtype, mesh, batch, vb, hw, classes):
+    model = ResNet50(num_classes=classes, num_filters=64,
+                     dtype=jnp.bfloat16, norm_param_dtype=norm_param_dtype)
+    loss_fn = classification_loss_fn(model)
+    tx = optax.sgd(0.1, momentum=0.9)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((vb, hw, hw, 3)), train=False)
+    params = variables["params"]
+    mstate = {k: v for k, v in variables.items() if k != "params"}
+    step = make_data_parallel_step(loss_fn, tx, mesh)
+    state = step.init_state(bench._deep_copy(params),
+                            model_state=bench._deep_copy(mstate))
+    compiled = step._fn.lower(state, batch).compile()
+    return compiled, state
+
+
+def main():
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    vb, hw, classes = 64, 224, 1000
+    images = jax.random.normal(jax.random.PRNGKey(1), (vb, hw, hw, 3))
+    labels = jax.random.randint(jax.random.PRNGKey(2), (vb,), 0, classes)
+    batch = shard_batch({"image": images, "label": labels}, mesh)
+
+    fp32_fn, fp32_state = build(None, mesh, batch, vb, hw, classes)
+    bf16_fn, bf16_state = build(jnp.bfloat16, mesh, batch, vb, hw, classes)
+
+    t_bf, t_fp, ratio = bench._time_pair(
+        lambda s, b: bf16_fn(s, b), bf16_state,
+        lambda s, b: fp32_fn(s, b), fp32_state, batch,
+        iters=30, repeats=5)
+    # ratio is _time_pair's drift-robust adjacent-pair median of
+    # t_fp32/t_bf16 — the headline number; the raw best-of minima are
+    # context only (they fold tunnel drift in, bench.py:53-56)
+    print(f"bf16-BN-state: {t_bf*1e3:.3f} ms   fp32-BN-state: "
+          f"{t_fp*1e3:.3f} ms   speedup(bf16-state, pair-median): "
+          f"{ratio:.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
