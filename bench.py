@@ -37,7 +37,11 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from byteps_tpu.common.timing import readback_barrier
+from byteps_tpu.common.timing import (
+    chained_grad_loop,
+    readback_barrier,
+    two_k_differenced_time,
+)
 from byteps_tpu.models import ResNet50, VGG16
 from byteps_tpu.models.bert import BertClassifier, bert_config
 from byteps_tpu.parallel.collectives import shard_map
@@ -521,6 +525,29 @@ def main():
 
         t_flash, t_naive, flash_ratio = _time_pair(
             attn_step("flash"), None, attn_step("naive"), None, qkv)
+
+        # True device time via two-K differencing: a lax.fori_loop chains
+        # the kernel+grads through its own inputs at K=4 and K=24; the
+        # median difference over adjacent call pairs divided by 20 cancels
+        # the tunnel's per-call fixed cost, which _time_pair only
+        # amortizes by 1/iters (~2-3 ms/call — r3 recorded flash D=128 at
+        # "MFU 0.2965" when the kernel's device time is ~0.45 MFU; the
+        # deficit was measurement overhead, not the kernel).
+        def _flash_loss(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, True)
+                           .astype(jnp.float32))
+
+        fKS, fKL = (4, 24) if on_tpu else (1, 3)
+        t_dev = two_k_differenced_time(
+            chained_grad_loop(_flash_loss, fKS),
+            chained_grad_loop(_flash_loss, fKL), qkv, fKS, fKL)
+        if t_dev is None:  # host noise beat the signal (CPU smoke)
+            t_dev, dev_method = t_flash, (
+                "FALLBACK host-chunk figure (two-K median non-positive: "
+                "per-call dispatch is NOT cancelled in this number)")
+        else:
+            dev_method = (f"two-K differenced fori_loop (K={fKS} vs "
+                          f"K={fKL}, median of 4 adjacent pairs)")
         # attention FLOPs: fwd = 2 matmuls * 2*B*H*T^2*D, halved by causal
         # masking; bwd ~ 2.5x fwd (4 matmuls + recompute) => total 3.5x
         flops = 3.5 * (2 * 2 * fb * fH * fT * fT * fD * 0.5)
@@ -531,18 +558,33 @@ def main():
         res = {
             "metric": (f"flash_attention_causal_T{fT}{tag}"
                        f"_tokens_per_sec{suffix}"),
+            # value stays on the host-chunk figure: the metric NAME is
+            # unchanged from r1-r3, so its SEMANTICS must be too — the
+            # device-true rate gets its own field below
             "value": round(fb * fT / t_flash, 2),
             "unit": "tokens/sec",
             "vs_baseline": round(flash_ratio, 4),
+            # host-chunk figures (comparable with r1-r3 artifacts); both
+            # sides pay the same per-call overhead so the ratio is fair
             "ms_per_step": round(t_flash * 1e3, 3),
             "ms_per_step_plain": round(t_naive * 1e3, 3),
+            # true device time (two-K differenced fori_loop) — the number
+            # MFU is honest against
+            "ms_per_step_device": round(t_dev * 1e3, 3),
+            "ms_per_step_device_method": dev_method,
+            "tokens_per_sec_device": round(fb * fT / t_dev, 2),
             "tflops_per_step": round(flops / 1e12, 4),
             "model_tflops_per_sec": round(flops / t_flash / 1e12, 2),
+            "model_tflops_per_sec_device": round(flops / t_dev / 1e12, 2),
         }
         if peak is not None:
             # unsharded single-device op (unlike the n_dev-scaled configs
-            # above): utilization is against ONE chip's peak
-            res["mfu"] = round(flops / t_flash / peak, 4)
+            # above): utilization is against ONE chip's peak.  Quoted
+            # against the DEVICE time (see mfu_basis) — r1-r3 quoted the
+            # dispatch-inflated host-chunk time; docs/performance.md
+            # documents the correction
+            res["mfu"] = round(flops / t_dev / peak, 4)
+            res["mfu_basis"] = "ms_per_step_device"
         results.append(res)
         print(json.dumps(res), flush=True)
 
@@ -646,6 +688,7 @@ def main():
         make_generate_fn,
         quantize_params,
         speculative_generate,
+        truncated_draft,
     )
 
     if on_tpu:
@@ -678,36 +721,30 @@ def main():
     del gvars_f32
     grng = jax.random.PRNGKey(0)
 
-    def _median_diff_ms(fn_s, fn_l, args_s, args_l, steps):
-        """Median over adjacent (short, long) call pairs of
-        (t_long - t_short) / steps, in ms.  If host-timing noise makes
-        the median difference non-positive (tiny CPU-smoke programs),
-        fall back to the unsplit long-call average rather than print a
-        nonsense rate.  Returns ``(ms_per_step, method)`` — the method
-        string records which estimator actually produced the number, so
-        a fallback row can't masquerade as differenced."""
-        readback_barrier(fn_s(*args_s), fn_l(*args_l))  # warm/compile
-        diffs, longs = [], []
-        for _ in range(rounds):
-            t0 = time.perf_counter()
-            readback_barrier(fn_s(*args_s))
-            ts = time.perf_counter() - t0
-            t0 = time.perf_counter()
-            readback_barrier(fn_l(*args_l))
-            tl = time.perf_counter() - t0
-            diffs.append(tl - ts)
-            longs.append(tl)
-        diffs.sort()
-        n = len(diffs)
-        med = (diffs[n // 2] if n % 2
-               else 0.5 * (diffs[n // 2 - 1] + diffs[n // 2]))
-        if med <= 0:
+    def _median_diff_ms(fn_s, fn_l, args, steps):
+        """Per-token decode time via the shared two-K differencing core
+        (common/timing.two_k_differenced_time): median over adjacent
+        (short, long) call pairs of (t_long - t_short) / steps, in ms.
+        If host-timing noise makes the median non-positive (tiny
+        CPU-smoke programs), fall back to the unsplit long-call average
+        rather than print a nonsense rate.  Returns ``(ms_per_step,
+        method)`` — the method string records which estimator actually
+        produced the number, so a fallback row can't masquerade as
+        differenced."""
+        per = two_k_differenced_time(fn_s, fn_l, args, 0, steps,
+                                     reps=rounds)
+        if per is None:
+            longs = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                readback_barrier(fn_l(*args))
+                longs.append(time.perf_counter() - t0)
             longs.sort()
             return (longs[len(longs) // 2] / (steps + nS) * 1e3,
                     f"FALLBACK unsplit long-call average over N={nL} "
                     "(median pair difference was non-positive: dispatch "
                     "and prefill are NOT cancelled in this number)")
-        return (med / steps * 1e3,
+        return (per * 1e3,
                 f"two-N differencing (N={nS} vs N={nL}, cache_len={CL}, "
                 f"median of {rounds} adjacent pairs)")
 
@@ -756,7 +793,7 @@ def main():
     gen_s = make_generate_fn(gmodel, nS, temperature=0, cache_len=CL)
     gen_l = make_generate_fn(gmodel, nL, temperature=0, cache_len=CL)
     ms_tok, m_tok = _median_diff_ms(gen_s, gen_l, (gvars, gprompt, grng),
-                             (gvars, gprompt, grng), nL - nS)
+                                    nL - nS)
 
     # greedy determinism checksum + divergence diagnosis (r3 weak #3):
     # at the first divergent position, is the cached path's token within
@@ -827,8 +864,8 @@ def main():
         gqa_model.init(jax.random.PRNGKey(12), gprompt))
     gqa_s = make_generate_fn(gqa_model, nS, temperature=0, cache_len=CL)
     gqa_l = make_generate_fn(gqa_model, nL, temperature=0, cache_len=CL)
-    ms_gqa, m_gqa = _median_diff_ms(gqa_s, gqa_l, (gqa_vars, gprompt, grng),
-                             (gqa_vars, gprompt, grng), nL - nS)
+    ms_gqa, m_gqa = _median_diff_ms(gqa_s, gqa_l,
+                                    (gqa_vars, gprompt, grng), nL - nS)
     gqa_np = _nonembed_params(gqa_vars["params"])
     res = _decode_row(
         f"generate_decode_gqa{gqa_kv}kv_T{gT}_tokens_per_sec{suffix}",
@@ -853,7 +890,7 @@ def main():
     # serve the B=1 prompt
     p1 = gprompt[:1]
     ms_b1, m_b1 = _median_diff_ms(gen_s, gen_l, (gvars, p1, grng),
-                            (gvars, p1, grng), nL - nS)
+                                  nL - nS)
     res = _decode_row(
         f"generate_decode_B1_T{gT}_tokens_per_sec{suffix}",
         (ms_b1, m_b1), 1, {})
@@ -861,7 +898,7 @@ def main():
     print(json.dumps(res), flush=True)
 
     ms_b1_q, m_b1_q = _median_diff_ms(gen_s, gen_l, (qvars, p1, grng),
-                              (qvars, p1, grng), nL - nS)
+                                      nL - nS)
     toks_bf16 = np.asarray(gen_l(gvars, p1, grng)["tokens"])
     toks_q = np.asarray(gen_l(qvars, p1, grng)["tokens"])
     # int8 divergence vs the bf16 decode: quantization legitimately moves
@@ -879,38 +916,58 @@ def main():
     results.append(res)
     print(json.dumps(res), flush=True)
 
-    # --- speculative decoding (draft = int8-quantized self) -----------
-    # Without a trained checkpoint the only *correlated* cheap draft is
-    # the target's own int8 quantization (token agreement ~0.95+), the
-    # quantized-self-draft setup; acceptance and speedup are recorded as
-    # measured.  Speedup is bounded by draft_cost/target_cost — with a
-    # distilled small draft the same machinery gains accordingly.
-    sp_s = functools.partial(
-        speculative_generate, gmodel, gvars, gmodel, qvars,
-        max_new_tokens=nS, gamma=4, cache_len=CL + 8)
-    sp_l = functools.partial(
-        speculative_generate, gmodel, gvars, gmodel, qvars,
-        max_new_tokens=nL, gamma=4, cache_len=CL + 8)
-    ms_spec, m_spec = _median_diff_ms(lambda p: sp_s(prompt=p),
-                              lambda p: sp_l(prompt=p),
-                              (p1,), (p1,), nL - nS)
-    out_spec = sp_l(prompt=p1)
-    res = {
-        "metric": f"speculative_B1_T{gT}_tokens_per_sec{suffix}",
-        "value": round(1 / (ms_spec / 1e3), 2),
-        "unit": "tokens/sec",
-        **_xrow_ratio(ms_b1, m_b1, ms_spec, m_spec),
-        "vs_baseline_meaning": "speedup over plain cached decode (B=1)",
-        "ms_per_token": round(ms_spec, 3),
-        "ms_per_token_method": m_spec,
-        "acceptance": round(float(out_spec["acceptance"]), 4),
-        "tokens_per_target_forward": round(
-            float(out_spec["tokens_per_target_forward"]), 2),
-        "gamma": 4,
-        "draft": "int8-quantized self (no trained draft checkpoint)",
-    }
-    results.append(res)
-    print(json.dumps(res), flush=True)
+    # --- speculative decoding: two self-draft variants ----------------
+    # Speculative speedup = f(draft cost, acceptance); without a TRAINED
+    # checkpoint no draft can have both (measured r4, probed at
+    # d_layers x gamma): the int8-quantized self is highly correlated
+    # (acc ~0.89) but costs ~0.83x the target per token, while the
+    # LayerSkip-style truncated self (inference.truncated_draft) is
+    # ~3x cheaper but a RANDOM-INIT model's early layers are
+    # uncorrelated with its full-depth argmax (acc ~0.01 — on trained
+    # weights early layers carry most of the signal and this variant is
+    # the standard free-draft choice).  Both rows are recorded honestly;
+    # the machinery's correctness (output == target-only greedy) is
+    # pinned by tests/test_speculative.py regardless of draft.
+    d_layers = max(1, gcfg.num_layers // 3)
+    lsk_model, lsk_vars = truncated_draft(gcfg, gvars, d_layers)
+    spec_variants = [
+        ("int8self", gmodel, qvars,
+         "int8-quantized self (correlated, acc ~0.9, but ~0.83x target "
+         "cost/token)"),
+        ("layerskip", lsk_model, lsk_vars,
+         f"target's first {d_layers} of {gcfg.num_layers} layers "
+         "(~3x cheaper; acceptance requires trained weights — random "
+         "init measures ~0)"),
+    ]
+    for sname, sdraft, sdvars, sdesc in spec_variants:
+        sp_s = functools.partial(
+            speculative_generate, gmodel, gvars, sdraft, sdvars,
+            max_new_tokens=nS, gamma=4, cache_len=CL + 8)
+        sp_l = functools.partial(
+            speculative_generate, gmodel, gvars, sdraft, sdvars,
+            max_new_tokens=nL, gamma=4, cache_len=CL + 8)
+        ms_spec, m_spec = _median_diff_ms(lambda p: sp_s(prompt=p),
+                                          lambda p: sp_l(prompt=p),
+                                          (p1,), nL - nS)
+        out_spec = sp_l(prompt=p1)
+        res = {
+            "metric": (f"speculative_{sname}_B1_T{gT}"
+                       f"_tokens_per_sec{suffix}"),
+            "value": round(1 / (ms_spec / 1e3), 2),
+            "unit": "tokens/sec",
+            **_xrow_ratio(ms_b1, m_b1, ms_spec, m_spec),
+            "vs_baseline_meaning": ("speedup over plain cached decode "
+                                    "(B=1)"),
+            "ms_per_token": round(ms_spec, 3),
+            "ms_per_token_method": m_spec,
+            "acceptance": round(float(out_spec["acceptance"]), 4),
+            "tokens_per_target_forward": round(
+                float(out_spec["tokens_per_target_forward"]), 2),
+            "gamma": 4,
+            "draft": sdesc,
+        }
+        results.append(res)
+        print(json.dumps(res), flush=True)
 
     # --- beam search (num_beams=4) ------------------------------------
     # Beam buys log-prob quality with K x the compute; vs_baseline is
@@ -921,8 +978,8 @@ def main():
     bm_l = functools.partial(beam_search, gmodel, gvars,
                              max_new_tokens=nL, num_beams=4, cache_len=CL)
     ms_beam, m_beam = _median_diff_ms(lambda p: bm_s(prompt=p),
-                              lambda p: bm_l(prompt=p),
-                              (gprompt,), (gprompt,), nL - nS)
+                                      lambda p: bm_l(prompt=p),
+                                      (gprompt,), nL - nS)
     res = {
         "metric": f"beam4_T{gT}_tokens_per_sec{suffix}",
         "value": round(gB / (ms_beam / 1e3), 2),

@@ -11,8 +11,65 @@ ends its timed region with ``readback_barrier``.
 
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
+
+
+def chained_grad_loop(loss_fn, k: int):
+    """Jitted ``fn(q, k, v)`` running ``k`` iterations of
+    ``value_and_grad(loss_fn)`` on-device, each feeding ``x + 1e-6*dx``
+    back as the next inputs — the data dependence keeps every iteration
+    live under XLA while leaving the measured program unchanged.  Pair
+    two of these (different ``k``) with ``two_k_differenced_time``."""
+    g = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))
+
+    def loop(q, kk, v):
+        def body(i, carry):
+            qc, kc, vc = carry
+            _, (dq, dk, dv) = g(qc, kc, vc)
+            return (qc + 1e-6 * dq, kc + 1e-6 * dk, vc + 1e-6 * dv)
+
+        qo, _, _ = jax.lax.fori_loop(0, k, body, (q, kk, v))
+        return jnp.sum(qo.astype(jnp.float32))
+
+    return jax.jit(loop)
+
+
+def two_k_differenced_time(fn_s, fn_l, args, k_s: int, k_l: int,
+                           reps: int = 4):
+    """Per-iteration device time via TWO-K DIFFERENCING.
+
+    ``fn_s``/``fn_l`` are the same jitted program iterated ``k_s`` and
+    ``k_l`` times on-device (e.g. a ``lax.fori_loop`` chaining a kernel
+    through its own outputs).  A single readback through the tunneled
+    runtime costs ~85-90 ms and sequential host calls may NOT pipeline,
+    so any per-call or per-chunk estimator folds that fixed cost into
+    the kernel time; the median of (t_long - t_short) over adjacent
+    call pairs cancels it exactly.
+
+    Returns seconds/iteration, or ``None`` when the median difference
+    is non-positive (host noise exceeded the signal — the caller must
+    fall back AND say so; see bench.py's method strings).
+    """
+    readback_barrier(fn_s(*args), fn_l(*args))  # warm / compile
+    diffs = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        readback_barrier(fn_s(*args))
+        ts = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        readback_barrier(fn_l(*args))
+        tl = time.perf_counter() - t0
+        diffs.append(tl - ts)
+    diffs.sort()
+    n = len(diffs)
+    med = (diffs[n // 2] if n % 2
+           else 0.5 * (diffs[n // 2 - 1] + diffs[n // 2]))
+    if med <= 0:
+        return None
+    return med / (k_l - k_s)
 
 
 def readback_barrier(*trees) -> float:

@@ -1,72 +1,94 @@
 """Block-size sweep for flash attention at D=128 (VERDICT r3 #6).
 
-Times fwd+bwd (the bench workload: sum-of-output loss, grads wrt q/k/v)
-for a grid of (block_q, block_k) at B=4 T=4096 H=8 D=128 causal bf16,
-via repeated-call best-of timing with a readback barrier.  Reports
-nominal MFU per config against the v5e bf16 peak.
+Times fwd+bwd (sum-of-output loss, grads wrt q/k/v) for a grid of
+(block_q, block_k) at B=4 T=4096 H=8 D=128 causal bf16.
+
+Methodology: TWO-K DIFFERENCING on an on-device ``lax.fori_loop`` that
+chains the kernel+grads through its own inputs — the loop is jitted at
+K=4 and K=24 and per-iter time is the median of (t_K24 - t_K4)/20 over
+adjacent call pairs.  On the tunneled runtime a single readback costs
+~85-90 ms (drifts by session) and sequential host calls do NOT
+pipeline, so any per-call or per-chunk estimator folds that fixed cost
+into the kernel time (a naive CHUNK=10 harness read this kernel at
+"12 ms/iter" when its true device time is ~5.4 ms).  The difference of
+two loop lengths cancels the fixed cost exactly.
+
+r4 result on the bench chip (TPU v5 lite):
+
+    bq= 512 bk= 512:  7.03 ms  MFU 0.347
+    bq= 512 bk=1024:  6.02 ms  MFU 0.406
+    bq= 512 bk=2048:  6.41 ms  MFU 0.381
+    bq=1024 bk= 512:  7.05 ms  MFU 0.347
+    bq=1024 bk=1024:  5.44 ms  MFU 0.449   <-- best (= the default)
+    bq=1024 bk=2048:  FAILED (VMEM)
+    bq=2048 bk= 512:  7.20 ms  MFU 0.339
+    bq= 256 bk=2048:  6.85 ms  MFU 0.357
+    fwd-only at 1024x1024: 1.07 ms, MFU 0.65 — the bwd kernels are the
+    headroom, not the fwd.
 """
 
 from __future__ import annotations
 
 import sys
-import time
 
 import jax
 import jax.numpy as jnp
 
 sys.path.insert(0, ".")
 
-from byteps_tpu.common.timing import readback_barrier
+from byteps_tpu.common.timing import (
+    chained_grad_loop,
+    two_k_differenced_time,
+)
 from byteps_tpu.ops.flash_attention import flash_attention
 
 B, T, H, D = 4, 4096, 8, 128
-ks = jax.random.split(jax.random.PRNGKey(5), 3)
-q, k, v = (jax.random.normal(kk, (B, T, H, D), jnp.bfloat16) for kk in ks)
-
+KS, KL = 4, 24
 FLOPS = 3.5 * (2 * 2 * B * H * T * T * D * 0.5)
 PEAK = 197e12
 
-grid = [(bq, bk)
-        for bq in (256, 512, 1024, 2048)
-        for bk in (256, 512, 1024, 2048)]
 
-results = {}
-fns = {}
-for bq, bk in grid:
-    def loss(q, k, v, bq=bq, bk=bk):
+def make_loop(bq, bk, Kn):
+    def loss(q, k, v):
         return jnp.sum(flash_attention(q, k, v, True, block_q=bq,
                                        block_k=bk).astype(jnp.float32))
 
-    fns[(bq, bk)] = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
+    return chained_grad_loop(loss, Kn)
 
-CHUNK = 10  # sequential calls per timed chunk: host dispatch pipelines
-            # behind device execution; one readback (in-order queue) ends it
 
-print("device:", jax.devices()[0].device_kind, flush=True)
-for key, fn in fns.items():
-    try:
-        readback_barrier(fn(q, k, v))
-        results[key] = float("inf")
-    except Exception as e:
-        print(f"bq={key[0]} bk={key[1]}: FAILED {type(e).__name__}",
-              flush=True)
+def main():
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q, k, v = (jax.random.normal(kk, (B, T, H, D), jnp.bfloat16)
+               for kk in ks)
+    grid = [(bq, bk)
+            for bq in (256, 512, 1024, 2048)
+            for bk in (256, 512, 1024, 2048)]
+    print("device:", jax.devices()[0].device_kind, flush=True)
+    results = {}
+    for bq, bk in grid:
+        try:
+            per = two_k_differenced_time(
+                make_loop(bq, bk, KS), make_loop(bq, bk, KL),
+                (q, k, v), KS, KL)
+        except Exception as e:
+            print(f"bq={bq:4d} bk={bk:4d}: FAILED {type(e).__name__}",
+                  flush=True)
+            continue
+        if per is None:
+            print(f"bq={bq:4d} bk={bk:4d}: noise (non-positive diff)",
+                  flush=True)
+            continue
+        results[(bq, bk)] = per
+        print(f"bq={bq:4d} bk={bk:4d}: {per*1e3:7.2f} ms  "
+              f"MFU {FLOPS / per / PEAK:.4f}", flush=True)
 
-for _ in range(5):
-    for key in list(results):
-        fn = fns[key]
-        t0 = time.perf_counter()
-        for _i in range(CHUNK):
-            out = fn(q, k, v)
-        readback_barrier(out)
-        results[key] = min(results[key],
-                           (time.perf_counter() - t0) / CHUNK)
+    if not results:
+        sys.exit("flash D=128 sweep: every (block_q, block_k) config "
+                 "failed — nothing to rank (see lines above)")
+    best = min(results, key=results.get)
+    print(f"BEST: bq={best[0]} bk={best[1]}  {results[best]*1e3:.2f} ms  "
+          f"MFU {FLOPS / results[best] / PEAK:.4f}", flush=True)
 
-if not results:
-    sys.exit("flash D=128 sweep: every (block_q, block_k) config failed "
-             "to compile — nothing to rank (see FAILED lines above)")
-best = min(results, key=results.get)
-for key in sorted(results):
-    t = results[key]
-    mark = "  <-- best" if key == best else ""
-    print(f"bq={key[0]:4d} bk={key[1]:4d}: {t*1e3:7.2f} ms  "
-          f"MFU {FLOPS / t / PEAK:.4f}{mark}", flush=True)
+
+if __name__ == "__main__":
+    main()

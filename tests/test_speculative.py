@@ -83,3 +83,29 @@ def test_spec_eos_matches_generate():
                                gamma=4, eos_id=eos, pad_id=0)
     np.testing.assert_array_equal(np.asarray(got["tokens"]),
                                   np.asarray(want["tokens"]))
+
+
+def test_truncated_self_draft_exact_and_cheap():
+    """LayerSkip-style self-draft (inference.truncated_draft): the
+    target's own first layers as draft — output still equals target-only
+    greedy (the speculative contract is draft-independent), the draft's
+    param tree is a strict subset sharing the target's arrays, and bad
+    layer counts raise."""
+    import pytest
+
+    from byteps_tpu.inference import truncated_draft
+
+    target, tvars, tokens = _model(4, 1)
+    dmodel, dvars = truncated_draft(target.cfg, tvars, 2)
+    assert dmodel.cfg.num_layers == 2
+    assert set(dvars["params"]) == {
+        "embed", "pos", "block_0", "block_1", "ln_f", "lm_head"}
+    # shared leaves, not copies
+    assert dvars["params"]["block_0"] is tvars["params"]["block_0"]
+    want = generate(target, tvars, tokens, 12, temperature=0)
+    got = speculative_generate(target, tvars, dmodel, dvars, tokens, 12,
+                               gamma=3)
+    np.testing.assert_array_equal(np.asarray(got["tokens"]),
+                                  np.asarray(want["tokens"]))
+    with pytest.raises(ValueError, match="num_layers"):
+        truncated_draft(target.cfg, tvars, 5)
