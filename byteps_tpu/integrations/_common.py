@@ -1,0 +1,17 @@
+"""Shared helpers for the HF weight converters."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def to_numpy(t) -> np.ndarray:
+    """torch tensor (or array-like) -> numpy.  bf16 torch tensors have
+    no numpy dtype, so they upcast to fp32 first (the converters cast
+    to fp32 anyway)."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu()
+        if str(t.dtype) == "torch.bfloat16":
+            t = t.float()
+        return t.numpy()
+    return np.asarray(t)

@@ -27,9 +27,11 @@ from __future__ import annotations
 
 from typing import Any, Mapping
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ._common import to_numpy as _np
 from ..models.transformer import Transformer, TransformerConfig
 
 __all__ = ["gpt2_config", "convert_gpt2_state_dict", "load_gpt2"]
@@ -68,9 +70,6 @@ def gpt2_config(hf_config, dtype=jnp.float32, **overrides):
     kw.update(overrides)
     return TransformerConfig(**kw)
 
-
-def _np(t) -> np.ndarray:
-    return t.detach().cpu().numpy() if hasattr(t, "detach") else np.asarray(t)
 
 
 def convert_gpt2_state_dict(sd: Mapping[str, Any],
@@ -120,8 +119,6 @@ def convert_gpt2_state_dict(sd: Mapping[str, Any],
     if not cfg.tie_embeddings:
         params["lm_head"] = {"kernel": _np(sd["lm_head.weight"]).T
                              .astype(np.float32)}
-    import jax
-
     return {"params": jax.tree_util.tree_map(jnp.asarray, params)}
 
 

@@ -1,0 +1,144 @@
+"""LLaMA architecture compatibility: convert HF ``LlamaForCausalLM``
+weights into the framework's Transformer.
+
+The reference is a communication library bolted onto existing frameworks
+(its model zoo stops at 2019-era torchvision/BERT); this rebuild ships
+its own model stack, and the LLaMA family is the modern open-weights
+standard — RMSNorm (already native), rotary embeddings
+(``pos_emb="rope"``), gated SwiGLU MLP (``mlp="swiglu"``), grouped-query
+attention (``num_kv_heads``), untied head.  With this module the whole
+inference stack — flash prefill, KV-cache generate (GQA-grouped, int8
+cache optional), beam search, speculative decoding, int8 weight-only
+quantization — runs on converted LLaMA weights.
+
+Weight layout notes (HF ``nn.Linear`` stores ``[out, in]`` — transposed
+relative to our kernels):
+
+* ``model.embed_tokens.weight [V, d]`` -> ``embed.embedding`` (no
+  transpose: embeddings are gathered, not multiplied).
+* ``layers.i.self_attn.{q,k,v}_proj.weight`` -> transpose ->
+  ``[d, H, Dh]`` / ``[d, KV, Dh]``.  HF applies the same half-split
+  ``rotate_half`` rotary convention as ``models.transformer.apply_rope``,
+  so q/k need no permutation.
+* ``layers.i.self_attn.o_proj.weight [d, H*Dh]`` -> transpose ->
+  ``[H, Dh, d]`` (heads flatten head-major on o_proj's input, matching
+  the reshape).
+* ``layers.i.mlp.{gate,up,down}_proj`` -> ``mlp.{gate,up,down}``.
+* ``layers.i.input_layernorm`` -> ``ln1``;
+  ``post_attention_layernorm`` -> ``ln2``; ``model.norm`` -> ``ln_f``
+  (RMSNorm: scale only).
+* ``lm_head.weight [V, d]`` -> transpose -> ``lm_head.kernel [d, V]``
+  (or tied when ``tie_word_embeddings``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._common import to_numpy as _np
+from ..models.transformer import Transformer, TransformerConfig
+
+__all__ = ["llama_config", "convert_llama_state_dict", "load_llama"]
+
+
+def llama_config(hf_config, dtype=jnp.float32, **overrides):
+    """TransformerConfig mirroring an HF ``LlamaConfig``.
+
+    Raises on config axes the framework model does not implement rather
+    than silently diverging from the torch reference.
+    """
+    act = getattr(hf_config, "hidden_act", "silu")
+    if act != "silu":
+        raise ValueError(
+            f"unsupported hidden_act {act!r}: the swiglu MLP hardcodes "
+            "silu gating")
+    scaling = getattr(hf_config, "rope_scaling", None)
+    if scaling not in (None, {}):
+        raise ValueError(
+            f"unsupported rope_scaling {scaling!r}: only vanilla RoPE "
+            "is implemented")
+    if getattr(hf_config, "attention_bias", False) or getattr(
+            hf_config, "mlp_bias", False):
+        raise ValueError(
+            "unsupported attention_bias/mlp_bias=True: LLaMA-family "
+            "checkpoints are bias-free and so is this conversion")
+    head_dim = getattr(hf_config, "head_dim", None)
+    implied = hf_config.hidden_size // hf_config.num_attention_heads
+    if head_dim is not None and head_dim != implied:
+        raise ValueError(
+            f"unsupported explicit head_dim {head_dim} != "
+            f"hidden_size/num_heads ({implied}): the framework model "
+            "derives the head dim from d_model")
+    kw = dict(
+        vocab_size=hf_config.vocab_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        num_kv_heads=getattr(hf_config, "num_key_value_heads", None),
+        d_model=hf_config.hidden_size,
+        d_ff=hf_config.intermediate_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        dtype=dtype,
+        causal=True,
+        norm="rmsnorm",
+        norm_eps=hf_config.rms_norm_eps,
+        use_bias=False,
+        tie_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+        pos_emb="rope",
+        rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+        mlp="swiglu",
+    )
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+
+def convert_llama_state_dict(sd: Mapping[str, Any],
+                             cfg: TransformerConfig) -> dict:
+    """Map an HF ``LlamaForCausalLM.state_dict()`` to a framework params
+    tree for ``Transformer(cfg)`` (cfg from :func:`llama_config`)."""
+    d, H, KV = cfg.d_model, cfg.num_heads, cfg.kv_heads
+    Dh = d // H
+
+    def g(key):
+        return _np(sd[f"model.{key}"]).astype(np.float32)
+
+    params: dict = {
+        "embed": {"embedding": g("embed_tokens.weight")},
+        "ln_f": {"scale": g("norm.weight")},
+    }
+    for i in range(cfg.num_layers):
+        p = f"layers.{i}"
+        params[f"block_{i}"] = {
+            "ln1": {"scale": g(f"{p}.input_layernorm.weight")},
+            "ln2": {"scale": g(f"{p}.post_attention_layernorm.weight")},
+            "attn": {
+                "q": {"kernel": g(f"{p}.self_attn.q_proj.weight").T
+                      .reshape(d, H, Dh)},
+                "k": {"kernel": g(f"{p}.self_attn.k_proj.weight").T
+                      .reshape(d, KV, Dh)},
+                "v": {"kernel": g(f"{p}.self_attn.v_proj.weight").T
+                      .reshape(d, KV, Dh)},
+                "o": {"kernel": g(f"{p}.self_attn.o_proj.weight").T
+                      .reshape(H, Dh, d)},
+            },
+            "mlp": {
+                "gate": {"kernel": g(f"{p}.mlp.gate_proj.weight").T},
+                "up": {"kernel": g(f"{p}.mlp.up_proj.weight").T},
+                "down": {"kernel": g(f"{p}.mlp.down_proj.weight").T},
+            },
+        }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"kernel": _np(sd["lm_head.weight"]).T
+                             .astype(np.float32)}
+    return {"params": jax.tree_util.tree_map(jnp.asarray, params)}
+
+
+def load_llama(hf_model, dtype=jnp.float32, **overrides):
+    """``(Transformer, variables)`` from a live ``LlamaForCausalLM``."""
+    cfg = llama_config(hf_model.config, dtype=dtype, **overrides)
+    variables = convert_llama_state_dict(hf_model.state_dict(), cfg)
+    return Transformer(cfg), variables

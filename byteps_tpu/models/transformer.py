@@ -68,6 +68,15 @@ class TransformerConfig:
     norm_eps: float = 1e-6
     use_bias: bool = False
     tie_embeddings: bool = False
+    # architecture axes for LLaMA-family compatibility
+    # (integrations/llama.py): rotary embeddings instead of a learned
+    # position table, and a gated SwiGLU MLP.  "rope" applies the HF
+    # half-split rotation to q/k inside Attention (position-aware in
+    # cached decode: cached keys are stored rotated, which preserves
+    # the relative-position property)
+    pos_emb: str = "learned"  # learned | rope | none
+    rope_theta: float = 10000.0
+    mlp: str = "gelu"  # gelu | swiglu
     # mesh axis names; attention shard_map uses (dp_axis, sp_axis, tp_axis)
     dp_axis: str = "dp"
     sp_axis: str = "sp"
@@ -236,6 +245,31 @@ def _quantize_kv(x):
     return q.astype(jnp.int8), scale
 
 
+def apply_rope(x, positions, theta: float = 10000.0):
+    """Rotary position embedding, HF half-split convention:
+    ``x [B, T, H, D]`` rotated by per-position angles
+    ``pos / theta^(2i/D)``; ``positions`` is ``[T]`` absolute offsets
+    (prefill: ``arange(T)``; decode step: ``pos + arange(tq)``).
+
+    The rotation acts on (x[..., :D/2], x[..., D/2:]) pairs — the same
+    ``rotate_half`` layout HF LLaMA uses, so converted q/k weights work
+    unpermuted (integrations/llama.py).  Computed in fp32 and cast back:
+    the angles lose too much to bf16 at long context.
+    """
+    D = x.shape[-1]
+    half = D // 2
+    inv_freq = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32)
+                                / half))
+    ang = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
+    cos = jnp.cos(ang)[None, :, None, :]   # [1, T, 1, D/2]
+    sin = jnp.sin(ang)[None, :, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., :half], xf[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    ).astype(x.dtype)
+
+
 def _group_q(q, KV):
     """``[B, tq, H, D] -> [B, KV, G*tq, D]`` with ``G = H // KV``: query
     heads fold onto their shared K/V head's batch row (group-major,
@@ -369,6 +403,16 @@ class Attention(nn.Module):
                               kernel_init=nn.initializers.xavier_uniform())
         k = kv_proj(features=(KV, D), name="k")(x)
         v = kv_proj(features=(KV, D), name="v")(x)
+        if cfg.pos_emb == "rope":
+            # rotate q/k before the cache write and before any attention
+            # path (flash/local/ring all consume rotated q/k; cached K
+            # is stored rotated — RoPE's relative-position property
+            # makes scores depend only on position deltas, so rotating
+            # at write time is exact)
+            rpos = (pos + jnp.arange(x.shape[1]) if cache is not None
+                    else jnp.arange(x.shape[1]))
+            q = apply_rope(q, rpos, cfg.rope_theta)
+            k = apply_rope(k, rpos, cfg.rope_theta)
         o_proj = QuantDense(
             features=cfg.d_model, in_axes=2, dtype=cfg.dtype, name="o",
             use_bias=cfg.use_bias,
@@ -481,14 +525,22 @@ class MLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        h = QuantDense(
-            features=cfg.d_ff, dtype=cfg.dtype, name="up",
+        col = partial(
+            QuantDense, features=cfg.d_ff, dtype=cfg.dtype,
             use_bias=cfg.use_bias,
             kernel_init=cfg.partition(
                 nn.initializers.xavier_uniform(), (None, cfg.tp_axis)
             ),
-        )(x)
-        h = nn.gelu(h)
+        )
+        if cfg.mlp == "swiglu":
+            # LLaMA-family gated MLP: down(silu(gate(x)) * up(x)).
+            # gate/up are column-parallel, down row-parallel — the same
+            # tp layout as the gelu variant, one extra matmul
+            h = nn.silu(col(name="gate")(x)) * col(name="up")(x)
+        elif cfg.mlp == "gelu":
+            h = nn.gelu(col(name="up")(x))
+        else:
+            raise ValueError(f"unknown mlp {cfg.mlp!r}")
         return QuantDense(
             features=cfg.d_model, dtype=cfg.dtype, name="down",
             use_bias=cfg.use_bias,
@@ -541,9 +593,12 @@ class Transformer(nn.Module):
                 nn.initializers.normal(stddev=0.02), (None, None)
             ),
         )
-        self.pos = nn.Embed(
-            cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype, name="pos",
-        )
+        if cfg.pos_emb == "learned":
+            self.pos = nn.Embed(
+                cfg.max_seq_len, cfg.d_model, dtype=cfg.dtype, name="pos",
+            )
+        elif cfg.pos_emb not in ("rope", "none"):
+            raise ValueError(f"unknown pos_emb {cfg.pos_emb!r}")
         self.blocks = [
             Block(cfg, name=f"block_{i}") for i in range(cfg.num_layers)
         ]
@@ -562,7 +617,8 @@ class Transformer(nn.Module):
         """Everything up to (and including) the final norm:
         ``[B, T] -> [B, T, d_model]``."""
         x = self.embed(tokens)
-        x = x + self.pos(jnp.arange(tokens.shape[1])[None, :])
+        if self.cfg.pos_emb == "learned":
+            x = x + self.pos(jnp.arange(tokens.shape[1])[None, :])
         for block in self.blocks:
             x = block(x)
         return self.ln_f(x)
@@ -602,7 +658,8 @@ class Transformer(nn.Module):
         logits would otherwise dominate prefill HBM at real vocab sizes.
         """
         x = self.embed(tokens)
-        x = x + self.pos((pos + jnp.arange(tokens.shape[1]))[None, :])
+        if self.cfg.pos_emb == "learned":
+            x = x + self.pos((pos + jnp.arange(tokens.shape[1]))[None, :])
         new_caches = []
         for block, c in zip(self.blocks, caches):
             x, nc = block(x, cache=c, pos=pos)
