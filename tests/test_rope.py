@@ -122,3 +122,23 @@ def test_bad_pos_emb_and_mlp_raise():
     with pytest.raises(ValueError, match="mlp"):
         Transformer(TransformerConfig(**dict(KW, mlp="geglu"))).init(
             jax.random.PRNGKey(0), toks)
+
+
+def test_rope_ring_sp_matches_local():
+    """RoPE composes with sequence parallelism: rotation happens with
+    global positions before the ring shard_map splits the sequence, so
+    the sp ring path equals the single-device local path."""
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(1, 4), ("dp", "sp"))
+    kw = dict(KW, max_seq_len=32)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 64)
+    cfg_r = TransformerConfig(attn_impl="ring", mesh=mesh, **kw)
+    cfg_l = TransformerConfig(attn_impl="local", **kw)
+    vs = Transformer(cfg_l).init(jax.random.PRNGKey(0), toks)
+    expected = Transformer(cfg_l).apply(vs, toks)
+    with mesh:
+        got = jax.jit(
+            lambda v, t: Transformer(cfg_r).apply(v, t))(vs, toks)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                               atol=3e-5, rtol=3e-5)
