@@ -221,10 +221,21 @@ class QuantDense(nn.Module):
             # step pay more in launch overhead than the s8 stream saves;
             # the mixed dot + AUTO input layouts — see
             # inference.make_generate_fn — reads s8 at full rate.)
+            #
+            # preferred_element_type MUST stay the operand dtype even
+            # when accum_dtype asks for f32: a mixed dot with an f32
+            # output makes XLA convert the whole s8 kernel to an f32
+            # temp hoisted OUT of the decode loop — the lm_head then
+            # streams 4 bytes/param instead of 1 (measured r4: 125 us vs
+            # 65 us per B=1 matvec at V=32k).  The MXU accumulates f32
+            # internally either way; the one extra bf16 rounding at the
+            # dot output is the same class as the bf16 weight rounding
+            # quantization already accepts, and the upcast-then-scale
+            # below restores the accum dtype for downstream sampling.
             y = jax.lax.dot_general(
                 x.astype(self.dtype), kernel, dims,
-                preferred_element_type=out_dtype)
-            y = y * scale.astype(out_dtype)
+                preferred_element_type=self.dtype)
+            y = y.astype(out_dtype) * scale.astype(out_dtype)
         else:
             y = jax.lax.dot_general(
                 x.astype(self.dtype), kernel.astype(self.dtype), dims,
