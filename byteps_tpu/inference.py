@@ -203,7 +203,8 @@ def make_generate_fn(model: Transformer, max_new_tokens: int, *,
                      eos_id: Optional[int] = None,
                      pad_id: int = 0,
                      kv_quant: bool = False,
-                     cache_len: Optional[int] = None):
+                     cache_len: Optional[int] = None,
+                     cache_layout: str = "auto"):
     """Build a jitted ``fn(variables, prompt [B, T], rng) -> dict`` that
     appends ``max_new_tokens`` sampled tokens to each prompt row.
 
@@ -222,6 +223,10 @@ def make_generate_fn(model: Transformer, max_new_tokens: int, *,
     longer cache costs bandwidth — use it to hold geometry constant
     across program variants, e.g. for benchmarking, or to reuse one
     compiled program across prompt lengths).
+
+    ``cache_layout`` forwards to ``init_cache``: "auto" (flat
+    decode-kernel layout on TPU, grouped elsewhere), "flat", or
+    "grouped".
     """
     cfg = model.cfg
 
@@ -235,7 +240,7 @@ def make_generate_fn(model: Transformer, max_new_tokens: int, *,
                 f"cache_len={cache_len} < prompt + max_new_tokens "
                 f"({need})")
         caches = init_cache(cfg, B, cache_len or need,
-                            quantized=kv_quant)
+                            quantized=kv_quant, layout=cache_layout)
         # prefill: one batched forward writes the prompt's K/V into the
         # cache; last_only keeps the LM head off the T-1 positions whose
         # [B, T, vocab] fp32 logits nobody reads
@@ -540,7 +545,11 @@ def _cached_spec_fn(target, draft, max_new_tokens, gamma, eos_id, pad_id,
                 f"cache_len={cache_len} < prompt + max_new_tokens + "
                 f"gamma + 1 ({need})")
         S = cache_len or need
-        t_caches = init_cache(tcfg, B, S)
+        # target cache: every target call is a tq=gamma+1 verify (or
+        # prefill) at a traced pos — the flat layout's tq>1 fallback
+        # would pay a physical cache relayout per round, so the target
+        # stays grouped; the draft's tq=1 steps get the flat kernel.
+        t_caches = init_cache(tcfg, B, S, layout="grouped")
         d_caches = init_cache(dcfg, B, S)
         # prefill both models; the target's last-position logits give the
         # first pending token

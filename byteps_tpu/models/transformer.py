@@ -445,10 +445,52 @@ class Attention(nn.Module):
             import math as _math
 
             quant_cache = cache["k"].dtype == jnp.int8
+            flat_cache = cache["k"].ndim == 3
             prefill_flash = (
                 isinstance(pos, int) and pos == 0 and x.shape[1] > 1
                 and cfg.attn_impl == "flash" and not cfg.has_sp
                 and _math.gcd(x.shape[1], 1024) >= 128)
+            if flat_cache:
+                # [B, S, KV*D] decode-native layout (init_cache
+                # layout="flat"): the cache IS the contiguous stream the
+                # fused decode kernel reads, so no per-step relayout
+                # ever happens — reshaping a [B, S, KV, D] cache costs
+                # a PHYSICAL copy of the whole cache every step
+                # (ops/decode_attention.py; measured 3.1x on MHA decode)
+                B_, T_ = x.shape[0], x.shape[1]
+                row_k = k.reshape(B_, T_, KV * D).astype(cache["k"].dtype)
+                row_v = v.reshape(B_, T_, KV * D).astype(cache["v"].dtype)
+                ck = jax.lax.dynamic_update_slice(
+                    cache["k"], row_k, (0, pos, 0))
+                cv = jax.lax.dynamic_update_slice(
+                    cache["v"], row_v, (0, pos, 0))
+                new_cache = {"k": ck, "v": cv}
+                if prefill_flash:
+                    from ..ops.flash_attention import flash_attention
+
+                    out = flash_attention(q, k, v, causal=True,
+                                          window=cfg.attn_window)
+                elif T_ == 1:
+                    from ..ops.decode_attention import decode_attention
+
+                    out = decode_attention(q, ck, cv, pos,
+                                           window=cfg.attn_window)
+                elif isinstance(pos, int) and pos == 0:
+                    # dense prefill fallback (awkward prompt lengths):
+                    # at static pos=0 the valid cache slots are exactly
+                    # the fresh k/v in hand — attend those directly and
+                    # never read the cache back
+                    out = _cached_attention(q, k, v, 0,
+                                            window=cfg.attn_window)
+                else:
+                    # tq>1 at pos>0 (speculative verify): dense path
+                    # needs the grouped view; pays the one relayout
+                    S_ = ck.shape[1]
+                    out = _cached_attention(
+                        q, ck.reshape(B_, S_, KV, D),
+                        cv.reshape(B_, S_, KV, D), pos,
+                        window=cfg.attn_window)
+                return o_proj(out), new_cache
             if quant_cache:
                 # int8 KV cache: K/V quantize at write time (per
                 # position+head scales); reads stay s8 end to end
@@ -681,15 +723,29 @@ class Transformer(nn.Module):
 
 
 def init_cache(cfg: TransformerConfig, batch_size: int, max_len: int,
-               quantized: bool = False):
-    """Zeroed per-layer KV caches ``[B, max_len, kv_heads, D]`` for
-    ``Transformer.decode``.  ``max_len`` must cover prompt + new tokens
-    and stay within ``cfg.max_seq_len`` (position embeddings).  Under
-    GQA (``cfg.num_kv_heads < num_heads``) the cache carries only the
+               quantized: bool = False, layout: str = "auto"):
+    """Zeroed per-layer KV caches for ``Transformer.decode``.
+    ``max_len`` must cover prompt + new tokens and stay within
+    ``cfg.max_seq_len`` (position embeddings).  Under GQA
+    (``cfg.num_kv_heads < num_heads``) the cache carries only the
     shared K/V heads — a num_heads/num_kv_heads shrink of decode's
     second-largest HBM stream.
 
-    ``quantized=True`` builds an int8 cache (s8 K/V plus f32
+    ``layout`` picks the decode data path (the cache is
+    self-describing; ``Attention`` dispatches on its ndim):
+
+    * ``"flat"`` — ``[B, max_len, kv_heads*D]``: the decode-native
+      layout consumed by the fused Pallas decode kernel
+      (ops/decode_attention.py) with zero per-step relayout.  Measured
+      3.1x (MHA) / 1.4x (GQA kv=2) over the dense path at T=1024.
+    * ``"grouped"`` — ``[B, max_len, kv_heads, D]``: the dense
+      mixed-dot path (required for the int8 cache, and the layout
+      tensor-parallel decode shards over its head axis).
+    * ``"auto"`` — flat on TPU for bf16 causal caches with a usable
+      chunk size; grouped otherwise (CPU tests keep the dense path —
+      interpret-mode Pallas per decode step would crawl).
+
+    ``quantized=True`` builds an int8 grouped cache (s8 K/V plus f32
     per-(position, head) scales): half the HBM bytes per decode step,
     quantization happens at write time inside ``Attention``.  Unwritten
     slots are masked out of attention, so the zero scales never feed the
@@ -698,6 +754,32 @@ def init_cache(cfg: TransformerConfig, batch_size: int, max_len: int,
         raise ValueError(
             f"cache max_len {max_len} exceeds max_seq_len {cfg.max_seq_len}")
     KV, D = cfg.kv_heads, cfg.d_model // cfg.num_heads
+    if layout not in ("auto", "flat", "grouped"):
+        raise ValueError(f"unknown cache layout {layout!r}")
+    if layout == "auto":
+        from ..ops.decode_attention import decode_attention_usable
+
+        # mesh guard: under a >1-device mesh the decode step's
+        # pallas_call would meet sharded operands GSPMD cannot
+        # partition (and tp decode shards the grouped head axis);
+        # sharded decode keeps the dense grouped path
+        unsharded = cfg.mesh is None or all(
+            s == 1 for s in cfg.mesh.shape.values())
+        use_flat = (not quantized and cfg.causal and unsharded
+                    and jax.default_backend() == "tpu"
+                    and decode_attention_usable(
+                        (batch_size, 1, cfg.num_heads, D), max_len,
+                        quantized))
+        layout = "flat" if use_flat else "grouped"
+    if layout == "flat":
+        if quantized:
+            raise ValueError("the int8 cache uses the grouped layout")
+        shape = (batch_size, max_len, KV * D)
+        return tuple(
+            {"k": jnp.zeros(shape, cfg.dtype),
+             "v": jnp.zeros(shape, cfg.dtype)}
+            for _ in range(cfg.num_layers)
+        )
     shape = (batch_size, max_len, KV, D)
     if quantized:
         return tuple(

@@ -1,0 +1,139 @@
+"""A/B: decode-attention kernel v2 vs the dense cached path, on-chip.
+
+Per-layer decode attention cost (dus cache write + attention read) at
+long context, cache carried through the loop like real decode.  Two-K
+differencing per the bench methodology (memory: readback ~85 ms fixed,
+only an on-device fori_loop differenced at two K values is trustworthy).
+"""
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+
+from byteps_tpu.common.timing import readback_barrier
+from byteps_tpu.models.transformer import _cached_attention
+from byteps_tpu.ops.decode_attention import decode_attention
+
+B, S, H, D = 8, 1280, 12, 64
+POS = 1024
+ROUNDS = 10
+K_S, K_L = 4, 44
+# One loop iteration = L layers, each with ITS OWN carried cache, like
+# the real 12-layer decode step.  A single-cache probe is a trap: the
+# 5 MB GQA cache goes VMEM-resident across the loop (measured 1579
+# "GB/s" — above HBM spec) and the dense path never touches HBM, a
+# regime no multi-layer model sees.
+L = 12
+
+
+def make_loop(impl, KV, K, block_s=512):
+    flat = impl == "kernel-flat"
+
+    @jax.jit
+    def run(q0, caches):
+        def body(i, carry):
+            q, caches = carry
+            pos = jnp.int32(POS) + 0 * i  # traced, like the real scan
+            new_caches = []
+            for (ck, cv) in caches:
+                if flat:
+                    row = q[:, :, :KV, :].reshape(
+                        q.shape[0], 1, KV * D).astype(ck.dtype)
+                    ck = jax.lax.dynamic_update_slice(
+                        ck, row, (0, POS, 0))
+                    cv = jax.lax.dynamic_update_slice(
+                        cv, row, (0, POS, 0))
+                else:
+                    k_new = q[:, :, :KV, :].astype(ck.dtype)
+                    ck = jax.lax.dynamic_update_slice(
+                        ck, k_new, (0, POS, 0, 0))
+                    cv = jax.lax.dynamic_update_slice(
+                        cv, k_new, (0, POS, 0, 0))
+                if impl == "dense":
+                    out = _cached_attention(q, ck, cv, pos)
+                else:
+                    out = decode_attention(q, ck, cv, pos,
+                                           block_s=block_s)
+                q = out.astype(q.dtype)
+                new_caches.append((ck, cv))
+            return (q, tuple(new_caches))
+
+        q, caches = jax.lax.fori_loop(0, K, body, (q0, caches))
+        tap = (caches[0][0][0, POS] if flat
+               else caches[0][0][0, POS, 0])
+        return jnp.sum(q.astype(jnp.float32)) + jnp.sum(
+            tap.astype(jnp.float32))
+
+    return run
+
+
+def _one_diff(fs, fl, args):
+    t0 = time.perf_counter(); readback_barrier(fs(*args))
+    ts = time.perf_counter() - t0
+    t0 = time.perf_counter(); readback_barrier(fl(*args))
+    tl = time.perf_counter() - t0
+    return (tl - ts) / ((K_L - K_S) * L) * 1e6
+
+
+def measure_pair(KV, impls, rounds=ROUNDS):
+    """Each impl is (label, impl_name, block_s).  Per round, every impl's
+    two-K difference is taken back to back, so the device's drifting rate
+    regime hits all impls alike; per-impl result is the median across
+    rounds of the *within-round* values (ratios between impls computed
+    per round stay fair — bench.py `_time_pair` rationale)."""
+    ks = jax.random.split(jax.random.PRNGKey(0), 1 + 2 * L)
+    q0 = jax.random.normal(ks[0], (B, 1, H, D), jnp.bfloat16)
+
+    def mk_caches(flat):
+        shape = (B, S, KV * D) if flat else (B, S, KV, D)
+        return tuple(
+            (jax.random.normal(ks[1 + 2 * i], shape, jnp.bfloat16),
+             jax.random.normal(ks[2 + 2 * i], shape, jnp.bfloat16))
+            for i in range(L))
+
+    caches = {False: mk_caches(False), True: mk_caches(True)}
+    fns = [(lab, im == "kernel-flat",
+            make_loop(im, KV, K_S, bs), make_loop(im, KV, K_L, bs))
+           for lab, im, bs in impls]
+    for _, flat, fs, fl in fns:
+        args = (q0, caches[flat])
+        readback_barrier(fs(*args), fl(*args))
+    per = {lab: [] for lab, _, _, _ in fns}
+    ratios = {lab: [] for lab, _, _, _ in fns[1:]}
+    for _ in range(rounds):
+        base = None
+        for lab, flat, fs, fl in fns:
+            us = _one_diff(fs, fl, (q0, caches[flat]))
+            per[lab].append(us)
+            if base is None:
+                base = us
+            else:
+                ratios[lab].append(base / us)
+    kv_bytes = 2 * B * S * KV * D * 2
+    out = {}
+    for lab, vals in per.items():
+        vals.sort()
+        med = vals[len(vals) // 2]
+        gbs = kv_bytes / (med / 1e6) / 1e9
+        rs = sorted(ratios.get(lab, []))
+        rtxt = (f"  ratio vs {fns[0][0]}: "
+                f"{rs[len(rs) // 2]:.3f}x" if rs else "")
+        print(f"{lab:16s} KV={KV:2d}: {med:8.2f} us/layer "
+              f"({gbs:6.1f} GB/s){rtxt}", flush=True)
+        out[lab] = med
+    return out
+
+
+if __name__ == "__main__":
+    print("device:", jax.devices()[0].device_kind,
+          f"B={B} S={S} H={H} D={D} pos={POS}", flush=True)
+    measure_pair(12, [("dense", "dense", 0),
+                      ("kernel-flat/640", "kernel-flat", 640),
+                      ("kernel-flat/1280", "kernel-flat", 1280)])
+    measure_pair(2, [("dense", "dense", 0),
+                     ("kernel-flat/640", "kernel-flat", 640),
+                     ("kernel-flat/1280", "kernel-flat", 1280)])

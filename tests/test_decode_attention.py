@@ -1,0 +1,86 @@
+"""Decode-attention kernel v2 vs the dense cached path (exact-match).
+
+The kernel must be a drop-in for ``_cached_attention`` at tq=1 —
+byte-level agreement is not expected (online softmax reassociates the
+f32 reductions) but bf16-tight agreement is.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from byteps_tpu.models.transformer import _cached_attention
+from byteps_tpu.ops.decode_attention import (
+    decode_attention,
+    decode_attention_usable,
+)
+
+
+def _mk(B, S, H, KV, D, pos, seed=0, dtype=jnp.bfloat16):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (B, 1, H, D), dtype)
+    ck = jax.random.normal(ks[1], (B, S, KV, D), dtype)
+    cv = jax.random.normal(ks[2], (B, S, KV, D), dtype)
+    # unwritten tail: garbage beyond pos must not leak into the output
+    tail = jnp.arange(S)[None, :, None, None] > pos
+    ck = jnp.where(tail, jnp.float32(37.0).astype(dtype), ck)
+    cv = jnp.where(tail, jnp.float32(-53.0).astype(dtype), cv)
+    return q, ck, cv
+
+
+@pytest.mark.parametrize("H,KV", [(4, 4), (8, 2), (4, 1)])
+@pytest.mark.parametrize("pos", [0, 63, 64, 200, 255])
+def test_matches_dense(H, KV, pos):
+    B, S, D = 2, 256, 64
+    q, ck, cv = _mk(B, S, H, KV, D, pos)
+    want = _cached_attention(q, ck, cv, pos)
+    got = decode_attention(q, ck, cv, pos, block_s=64, interpret=True)
+    assert got.shape == want.shape == (B, 1, H, D)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               atol=2e-2, rtol=2e-2)
+
+
+@pytest.mark.parametrize("pos", [10, 100, 190])
+def test_matches_dense_window(pos):
+    B, S, H, KV, D = 1, 192, 4, 2, 64
+    q, ck, cv = _mk(B, S, H, KV, D, pos, seed=3)
+    want = _cached_attention(q, ck, cv, pos, window=48)
+    got = decode_attention(q, ck, cv, pos, window=48, block_s=64,
+                           interpret=True)
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want, np.float32),
+                               atol=2e-2, rtol=2e-2)
+
+
+def test_traced_pos_one_program():
+    """pos may be a traced scalar (the generate scan carry): one compiled
+    program must serve every step."""
+    B, S, H, KV, D = 1, 128, 4, 4, 64
+    q, ck, cv = _mk(B, S, H, KV, D, 127, seed=5)
+
+    traces = []
+
+    @jax.jit
+    def step(q, ck, cv, pos):
+        traces.append(None)
+        return decode_attention(q, ck, cv, pos, block_s=64,
+                                interpret=True)
+
+    for pos in (0, 31, 64, 127):
+        want = _cached_attention(q, ck, cv, pos)
+        got = step(q, ck, cv, jnp.int32(pos))
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(want, np.float32),
+                                   atol=2e-2, rtol=2e-2)
+    assert len(traces) == 1
+
+
+def test_usable_gate():
+    assert decode_attention_usable((8, 1, 12, 64), 1280, False)
+    assert not decode_attention_usable((8, 4, 12, 64), 1280, False)
+    assert not decode_attention_usable((8, 1, 12, 64), 1280, True)
+    # awkward cache lengths are fine: the grid is ceil(S/block) with the
+    # tail masked
+    assert decode_attention_usable((8, 1, 12, 64), 1021, False)
