@@ -912,6 +912,13 @@ def main():
             "token_agreement_vs_bf16": round(div_q["agreement"], 4),
             "divergence": div_q["divergence"],
             "first_div_delta_logit": div_q.get("delta_logit", 0.0),
+            # why sub-1.0 agreement at "tie" is benign: s8 rounding moves
+            # logits ~1% of span, a near-tie argmax flips somewhere
+            # mid-sequence, and the contexts legitimately differ from
+            # that point on — the quarter profile shows churn ramping
+            # with position, not a cliff at an early position
+            "first_div_positions": div_q.get("first_div_positions", []),
+            "div_frac_by_quarter": div_q.get("div_frac_by_quarter", []),
         })
     results.append(res)
     print(json.dumps(res), flush=True)
@@ -998,6 +1005,55 @@ def main():
     headline = dict(results[0])
     headline["configs"] = results
     print(json.dumps(headline), flush=True)
+
+    # compact certification line printed LAST (r4 verdict: the driver
+    # archives only the final ~2000 chars of stdout, and r4's artifact
+    # truncated away the train rows' bar_pass self-certification — the
+    # full-matrix headline above is too big to survive the tail).  This
+    # line restates every bar-certified row's verdict plus the headline
+    # numbers in well under 1500 chars, so the artifact of record is
+    # self-contained.
+    line = json.dumps(_certification(results, headline))
+    assert len(line) < 1900, f"certification line too long: {len(line)}"
+    print(line, flush=True)
+
+
+def _certification(results, headline):
+    def _find(sub):
+        for r in results:
+            if sub in r["metric"]:
+                return r
+        return {}
+
+    bar_rows = [r for r in results if "bar_pass" in r]
+    return {
+        "metric": "certification",
+        "value": 1.0 if all(r["bar_pass"] for r in bar_rows) else 0.0,
+        "unit": "bar_pass_all",
+        "vs_baseline": headline.get("vs_baseline"),
+        "rows": len(results),
+        "bar_pass_all": bool(all(r["bar_pass"] for r in bar_rows)),
+        "bar_fails": [r["metric"] for r in bar_rows if not r["bar_pass"]],
+        # per-row [vs_baseline, aa_spread, pass] for every certified row
+        "bars": {r["metric"]: [r["vs_baseline"], r.get("aa_spread"),
+                               r["bar_pass"]] for r in bar_rows},
+        "key_numbers": {
+            "resnet50_bf16_img_s": _find("resnet50_bf16").get("value"),
+            "resnet50_fp32_img_s": _find("resnet50_fp32").get("value"),
+            "vgg16_img_s": _find("vgg16").get("value"),
+            "bert_tok_s": _find("bert").get("value"),
+            "flash_d128_mfu": _find("_D128_").get("mfu"),
+            "flash_d64_mfu": _find("flash_attention_causal").get("mfu"),
+            "lm_flash_vs_naive": _find("lm_train_flash").get(
+                "vs_baseline"),
+            "decode_b8_ms_tok": _find("generate_decode_T").get(
+                "ms_per_token_decode"),
+            "decode_gqa_ms_tok": _find("generate_decode_gqa").get(
+                "ms_per_token_decode"),
+            "decode_b1_int8_vs_bf16": _find("int8_tokens").get(
+                "vs_baseline"),
+        },
+    }
 
 
 if __name__ == "__main__":

@@ -149,8 +149,15 @@ class ServerProfiler:
         self._path = path
         self._key_filter = key_filter
         self._events: List[dict] = []
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()        # guards the event buffer
+        self._io_lock = threading.Lock()     # serializes file appends
         self._written = False  # file has an opening '[' + >=1 event
+        self._closed = False
+        # chrome-trace ts must be monotonic: wall-clock steps (NTP) can
+        # emit out-of-order or negative-duration B/E spans, so callers
+        # stamp with time.perf_counter() and this fixed epoch maps the
+        # values onto the wall clock once
+        self._epoch = time.time() - time.perf_counter()
 
     def record(self, op: int, name: str, peer: str, t_begin: float,
                t_end: float) -> None:
@@ -161,42 +168,61 @@ class ServerProfiler:
         if self._key_filter is not None and key != self._key_filter:
             return
         ev = f"{opname}-{peer}"
+        b = {"name": ev, "ph": "B", "pid": key, "tid": key,
+             "ts": int((self._epoch + t_begin) * 1e6)}
+        e = {"name": ev, "ph": "E", "pid": key, "tid": key,
+             "ts": int((self._epoch + t_end) * 1e6)}
+        drained = None
         with self._lock:
-            self._events.append({"name": ev, "ph": "B", "pid": key,
-                                 "tid": key, "ts": int(t_begin * 1e6)})
-            self._events.append({"name": ev, "ph": "E", "pid": key,
-                                 "tid": key, "ts": int(t_end * 1e6)})
+            self._events.append(b)
+            self._events.append(e)
             if len(self._events) >= self._AUTOFLUSH:
-                self._drain_locked()
+                # swap the buffer out under the lock, write OUTSIDE it —
+                # the request that trips the threshold must not stall
+                # every concurrent handler behind file I/O
+                drained, self._events = self._events, []
+        if drained:
+            self._write(drained)
 
-    def _drain_locked(self) -> None:
-        """Append buffered events to the file (caller holds the lock).
-        The buffer is drained — flushes are O(new events), never a
-        rewrite of history — and the file is a chrome-trace JSON array
-        kept loadable mid-run by the viewer's documented leniency about
-        a missing closing bracket; ``close()`` terminates it properly."""
+    def _write(self, events: List[dict]) -> None:
+        """Append drained events to the file (``_io_lock`` serializes
+        concurrent drains so appends stay ordered).  Flushes are O(new
+        events), never a rewrite of history, and the file is a
+        chrome-trace JSON array kept loadable mid-run by the viewer's
+        documented leniency about a missing closing bracket; ``close()``
+        terminates it properly."""
         import json
 
-        events, self._events = self._events, []
-        if not events:
-            return
-        mode = "a" if self._written else "w"
-        with open(self._path, mode) as f:
-            for ev in events:
-                f.write(("[\n" if not self._written else ",\n")
-                        + json.dumps(ev))
-                self._written = True
+        with self._io_lock:
+            if self._closed:
+                # a record() thread swapped its batch out just as
+                # close() terminated the array — appending now would
+                # write past the closing ']' and corrupt the strict
+                # JSON close() promises; drop the stragglers
+                bps_log.debug(
+                    "ps_server profiler: dropping %d events raced "
+                    "against close()", len(events))
+                return
+            mode = "a" if self._written else "w"
+            with open(self._path, mode) as f:
+                for ev in events:
+                    f.write(("[\n" if not self._written else ",\n")
+                            + json.dumps(ev))
+                    self._written = True
         bps_log.debug("ps_server profiler: +%d events -> %s",
                       len(events), self._path)
 
     def flush(self) -> None:
         with self._lock:
-            self._drain_locked()
+            events, self._events = self._events, []
+        if events:
+            self._write(events)
 
     def close(self) -> None:
         """Drain and terminate the JSON array (valid strict JSON)."""
-        with self._lock:
-            self._drain_locked()
+        self.flush()
+        with self._io_lock:
+            self._closed = True
             if self._written:
                 with open(self._path, "a") as f:
                     f.write("\n]\n")
@@ -217,7 +243,7 @@ class _Handler(socketserver.BaseRequestHandler):
                     op, name, arr, _ = _decode(sock)
                 except ConnectionError:
                     return
-                t_begin = time.time()
+                t_begin = time.perf_counter()
                 # store-level errors (e.g. pull of an un-init'd name) reply
                 # status=1 and keep the connection alive — only wire-level
                 # failures tear it down
@@ -247,7 +273,8 @@ class _Handler(socketserver.BaseRequestHandler):
                         1, "", None, f"{type(e).__name__}: {e}".encode()
                     )
                 if profiler is not None:
-                    profiler.record(op, name, peer, t_begin, time.time())
+                    profiler.record(op, name, peer, t_begin,
+                                    time.perf_counter())
                 sock.sendall(reply)
         except Exception as e:  # pragma: no cover - connection teardown races
             bps_log.debug("ps_server handler exit: %s", e)

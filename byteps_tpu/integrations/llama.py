@@ -57,10 +57,19 @@ def llama_config(hf_config, dtype=jnp.float32, **overrides):
             f"unsupported hidden_act {act!r}: the swiglu MLP hardcodes "
             "silu gating")
     scaling = getattr(hf_config, "rope_scaling", None)
-    if scaling not in (None, {}):
-        raise ValueError(
-            f"unsupported rope_scaling {scaling!r}: only vanilla RoPE "
-            "is implemented")
+    rope_scaling = None
+    if scaling:
+        rt = scaling.get("rope_type", scaling.get("type", "default"))
+        if rt not in ("default", "linear", "llama3"):
+            raise ValueError(
+                f"unsupported rope_scaling type {rt!r}: implemented "
+                "schedules are llama3 and linear "
+                "(models.transformer._scaled_inv_freq)")
+        if rt != "default":
+            # tuple of sorted pairs keeps TransformerConfig hashable
+            rope_scaling = tuple(sorted(
+                (k, float(v) if isinstance(v, (int, float)) else v)
+                for k, v in scaling.items()))
     if getattr(hf_config, "attention_bias", False) or getattr(
             hf_config, "mlp_bias", False):
         raise ValueError(
@@ -68,11 +77,8 @@ def llama_config(hf_config, dtype=jnp.float32, **overrides):
             "checkpoints are bias-free and so is this conversion")
     head_dim = getattr(hf_config, "head_dim", None)
     implied = hf_config.hidden_size // hf_config.num_attention_heads
-    if head_dim is not None and head_dim != implied:
-        raise ValueError(
-            f"unsupported explicit head_dim {head_dim} != "
-            f"hidden_size/num_heads ({implied}): the framework model "
-            "derives the head dim from d_model")
+    if head_dim == implied:
+        head_dim = None  # explicit-but-redundant: derive it
     kw = dict(
         vocab_size=hf_config.vocab_size,
         num_layers=hf_config.num_hidden_layers,
@@ -89,6 +95,8 @@ def llama_config(hf_config, dtype=jnp.float32, **overrides):
         tie_embeddings=getattr(hf_config, "tie_word_embeddings", False),
         pos_emb="rope",
         rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+        rope_scaling=rope_scaling,
+        head_dim=head_dim,
         mlp="swiglu",
     )
     kw.update(overrides)
@@ -101,7 +109,7 @@ def convert_llama_state_dict(sd: Mapping[str, Any],
     """Map an HF ``LlamaForCausalLM.state_dict()`` to a framework params
     tree for ``Transformer(cfg)`` (cfg from :func:`llama_config`)."""
     d, H, KV = cfg.d_model, cfg.num_heads, cfg.kv_heads
-    Dh = d // H
+    Dh = cfg.d_head  # Llama-3.x may set head_dim != hidden_size/heads
 
     def g(key):
         return _np(sd[f"model.{key}"]).astype(np.float32)

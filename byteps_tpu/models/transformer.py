@@ -76,12 +76,26 @@ class TransformerConfig:
     # the relative-position property)
     pos_emb: str = "learned"  # learned | rope | none
     rope_theta: float = 10000.0
+    # frequency-rescaled RoPE for long-context checkpoints (Llama-3.x):
+    # a tuple of sorted (key, value) pairs (tuples keep the config
+    # hashable) mirroring HF's rope_scaling dict — rope_type "llama3"
+    # (factor / low_freq_factor / high_freq_factor /
+    # original_max_position_embeddings) or "linear" (factor)
+    rope_scaling: Optional[tuple] = None
+    # explicit per-head dim (Llama-3.x checkpoints may set
+    # head_dim != hidden_size / num_heads); None derives it
+    head_dim: Optional[int] = None
     mlp: str = "gelu"  # gelu | swiglu
     # mesh axis names; attention shard_map uses (dp_axis, sp_axis, tp_axis)
     dp_axis: str = "dp"
     sp_axis: str = "sp"
     tp_axis: str = "tp"
     mesh: Optional[Mesh] = None
+
+    @property
+    def d_head(self) -> int:
+        return (self.head_dim if self.head_dim is not None
+                else self.d_model // self.num_heads)
 
     @property
     def kv_heads(self) -> int:
@@ -256,7 +270,37 @@ def _quantize_kv(x):
     return q.astype(jnp.int8), scale
 
 
-def apply_rope(x, positions, theta: float = 10000.0):
+def _scaled_inv_freq(inv_freq, scaling):
+    """Frequency rescaling for long-context RoPE variants, matching HF's
+    ``_compute_llama3_parameters`` / linear scaling exactly (the angles
+    must agree with the torch reference for converted checkpoints).
+
+    ``scaling`` is a dict or tuple of pairs: rope_type "linear" divides
+    every frequency by ``factor``; "llama3" keeps high frequencies,
+    divides low ones, and smoothly interpolates the band between
+    (wavelengths measured against original_max_position_embeddings)."""
+    s = dict(scaling)
+    rt = s.get("rope_type", s.get("type", "default"))
+    if rt in (None, "default"):
+        return inv_freq
+    factor = float(s.get("factor", 1.0))
+    if rt == "linear":
+        return inv_freq / factor
+    if rt == "llama3":
+        low = float(s.get("low_freq_factor", 1.0))
+        high = float(s.get("high_freq_factor", 4.0))
+        orig = float(s.get("original_max_position_embeddings", 8192))
+        wavelen = 2.0 * jnp.pi / inv_freq
+        scaled = inv_freq / factor
+        smooth = (orig / wavelen - low) / (high - low)
+        smoothed = (1.0 - smooth) * scaled + smooth * inv_freq
+        return jnp.where(
+            wavelen < orig / high, inv_freq,
+            jnp.where(wavelen > orig / low, scaled, smoothed))
+    raise ValueError(f"unsupported rope_scaling type {rt!r}")
+
+
+def apply_rope(x, positions, theta: float = 10000.0, scaling=None):
     """Rotary position embedding, HF half-split convention:
     ``x [B, T, H, D]`` rotated by per-position angles
     ``pos / theta^(2i/D)``; ``positions`` is ``[T]`` absolute offsets
@@ -265,12 +309,16 @@ def apply_rope(x, positions, theta: float = 10000.0):
     The rotation acts on (x[..., :D/2], x[..., D/2:]) pairs — the same
     ``rotate_half`` layout HF LLaMA uses, so converted q/k weights work
     unpermuted (integrations/llama.py).  Computed in fp32 and cast back:
-    the angles lose too much to bf16 at long context.
+    the angles lose too much to bf16 at long context.  ``scaling``
+    applies the Llama-3-family frequency rescale (see
+    ``_scaled_inv_freq``).
     """
     D = x.shape[-1]
     half = D // 2
     inv_freq = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32)
                                 / half))
+    if scaling is not None:
+        inv_freq = _scaled_inv_freq(inv_freq, scaling)
     ang = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
     cos = jnp.cos(ang)[None, :, None, :]   # [1, T, 1, D/2]
     sin = jnp.sin(ang)[None, :, None, :]
@@ -392,7 +440,7 @@ class Attention(nn.Module):
     @nn.compact
     def __call__(self, x, key_mask=None, cache=None, pos=None):
         cfg = self.cfg
-        H, D = cfg.num_heads, cfg.d_model // cfg.num_heads
+        H, D = cfg.num_heads, cfg.d_head
         KV = cfg.kv_heads
         proj = partial(
             QuantDense, dtype=cfg.dtype, use_bias=cfg.use_bias,
@@ -422,8 +470,8 @@ class Attention(nn.Module):
             # at write time is exact)
             rpos = (pos + jnp.arange(x.shape[1]) if cache is not None
                     else jnp.arange(x.shape[1]))
-            q = apply_rope(q, rpos, cfg.rope_theta)
-            k = apply_rope(k, rpos, cfg.rope_theta)
+            q = apply_rope(q, rpos, cfg.rope_theta, cfg.rope_scaling)
+            k = apply_rope(k, rpos, cfg.rope_theta, cfg.rope_scaling)
         o_proj = QuantDense(
             features=cfg.d_model, in_axes=2, dtype=cfg.dtype, name="o",
             use_bias=cfg.use_bias,
@@ -532,6 +580,14 @@ class Attention(nn.Module):
 
                 out = flash_attention(q, k, v, causal=True,
                                       window=cfg.attn_window)
+            elif quant_cache and isinstance(pos, int) and pos == 0:
+                # dense prefill on the exact pre-quantization k/v in
+                # hand: without this, prompt lengths failing the flash
+                # gcd gate attended the prompt against already-quantized
+                # K/V, so first-token logits carried a quantization
+                # error that varied with prompt length (r4 advisor)
+                out = _cached_attention(q, k, v, 0,
+                                        window=cfg.attn_window)
             elif quant_cache:
                 out = _cached_attention_q8(q, ck, cks, cv, cvs, pos,
                                            window=cfg.attn_window)
@@ -753,7 +809,7 @@ def init_cache(cfg: TransformerConfig, batch_size: int, max_len: int,
     if max_len > cfg.max_seq_len:
         raise ValueError(
             f"cache max_len {max_len} exceeds max_seq_len {cfg.max_seq_len}")
-    KV, D = cfg.kv_heads, cfg.d_model // cfg.num_heads
+    KV, D = cfg.kv_heads, cfg.d_head
     if layout not in ("auto", "flat", "grouped"):
         raise ValueError(f"unknown cache layout {layout!r}")
     if layout == "auto":

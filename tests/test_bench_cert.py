@@ -1,0 +1,66 @@
+"""The bench certification line (r4 verdict #3): printed LAST, compact
+enough to survive the driver's ~2000-char stdout tail, and carrying every
+bar-certified row's verdict + the headline numbers."""
+
+import json
+
+from bench import _certification
+
+
+def _rows():
+    return [
+        {"metric": "resnet50_fp32_b64_images_per_sec", "value": 1128.0,
+         "unit": "images/sec", "vs_baseline": 1.005, "aa_spread": 0.01,
+         "bar_pass": True},
+        {"metric": "resnet50_bf16_b64_images_per_sec", "value": 2064.0,
+         "unit": "images/sec", "vs_baseline": 1.005, "aa_spread": 0.01,
+         "bar_pass": True},
+        {"metric": "vgg16_fp32_b64_images_per_sec", "value": 731.0,
+         "unit": "images/sec", "vs_baseline": 0.995, "aa_spread": 0.02,
+         "bar_pass": True},
+        {"metric": "bert_base_finetune_tokens_per_sec", "value": 198000.0,
+         "unit": "tokens/sec", "vs_baseline": 1.0, "aa_spread": 0.01,
+         "bar_pass": False},
+        {"metric": "flash_attention_causal_T4096_tokens_per_sec",
+         "value": 1.9e6, "unit": "tokens/sec", "vs_baseline": 4.05,
+         "mfu": 0.212},
+        {"metric": "flash_attention_causal_T4096_D128_tokens_per_sec",
+         "value": 2.9e6, "unit": "tokens/sec", "vs_baseline": 3.78,
+         "mfu": 0.449},
+        {"metric": "lm_train_flash_T2048_tokens_per_sec", "value": 97000.0,
+         "unit": "tokens/sec", "vs_baseline": 2.21},
+        {"metric": "generate_decode_T256_N32_tokens_per_sec",
+         "value": 10800.0, "unit": "tokens/sec", "vs_baseline": 2.73,
+         "ms_per_token_decode": 0.74},
+        {"metric": "generate_decode_gqa2kv_T256_tokens_per_sec",
+         "value": 29000.0, "unit": "tokens/sec",
+         "ms_per_token_decode": 0.27},
+        {"metric": "generate_decode_B1_T256_int8_tokens_per_sec",
+         "value": 4200.0, "unit": "tokens/sec", "vs_baseline": 1.2},
+    ]
+
+
+def test_certification_line():
+    rows = _rows()
+    cert = _certification(rows, rows[0])
+    assert cert["metric"] == "certification"
+    assert cert["rows"] == len(rows)
+    assert cert["bar_pass_all"] is False
+    assert cert["bar_fails"] == ["bert_base_finetune_tokens_per_sec"]
+    assert len(cert["bars"]) == 4
+    kn = cert["key_numbers"]
+    assert kn["resnet50_bf16_img_s"] == 2064.0
+    assert kn["flash_d128_mfu"] == 0.449
+    assert kn["lm_flash_vs_naive"] == 2.21
+    assert kn["decode_b8_ms_tok"] == 0.74
+    assert kn["decode_gqa_ms_tok"] == 0.27
+    assert kn["decode_b1_int8_vs_bf16"] == 1.2
+    # must survive the driver's ~2000-char tail capture
+    assert len(json.dumps(cert)) < 1900
+
+
+def test_certification_all_pass_flag():
+    rows = [r for r in _rows()
+            if r["metric"] != "bert_base_finetune_tokens_per_sec"]
+    cert = _certification(rows, rows[0])
+    assert cert["bar_pass_all"] is True and cert["value"] == 1.0

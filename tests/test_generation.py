@@ -352,3 +352,37 @@ def test_flat_cache_auto_layout_cpu_is_grouped():
     # CPU backend: auto resolves to grouped (interpret-mode Pallas per
     # decode step would crawl); the TPU resolution is covered on-chip
     assert caches[0]["k"].ndim == 4
+
+
+def test_classify_divergence_position_profile():
+    """The position profile separates late near-tie churn from an early
+    cliff (r4 verdict: one sentence of diagnosis next to the number)."""
+    from byteps_tpu.inference import classify_divergence
+
+    cfg, model, tokens, variables = _tiny_model()
+    N = 16
+    base = np.asarray(
+        jax.random.randint(jax.random.PRNGKey(9), (2, N), 0, 50))
+    # churn: row 0 diverges late (pos 12), row 1 later (pos 14)
+    churn = base.copy()
+    churn[0, 12:] = (churn[0, 12:] + 1) % 50
+    churn[1, 14:] = (churn[1, 14:] + 1) % 50
+    res = classify_divergence(model, variables, tokens[:, :4],
+                              base, churn)
+    assert res["first_div_positions"] == [12, 14]
+    q = res["div_frac_by_quarter"]
+    assert len(q) == 4 and q[0] == 0.0 and q[1] == 0.0 and q[3] == 0.75
+    # cliff: both rows diverge from pos 1
+    cliff = base.copy()
+    cliff[:, 1:] = (cliff[:, 1:] + 1) % 50
+    res = classify_divergence(model, variables, tokens[:, :4],
+                              base, cliff)
+    assert res["first_div_positions"] == [1, 1]
+    assert res["div_frac_by_quarter"][0] > 0.5
+    # identical rows report -1
+    same = base.copy()
+    same[1] = base[1]
+    mix = base.copy()
+    mix[0, 5:] = (mix[0, 5:] + 3) % 50
+    res = classify_divergence(model, variables, tokens[:, :4], base, mix)
+    assert res["first_div_positions"] == [5, -1]

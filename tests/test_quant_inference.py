@@ -12,7 +12,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from byteps_tpu.inference import generate, quantize_params
-from byteps_tpu.models.transformer import Transformer, TransformerConfig
+from byteps_tpu.models.transformer import (
+    Transformer,
+    TransformerConfig,
+    init_cache,
+)
 
 
 def _model():
@@ -180,3 +184,33 @@ def test_generate_cache_len_overallocation():
     out_b = make_generate_fn(model, 8, temperature=0, cache_len=40)(
         variables, tokens, jax.random.PRNGKey(0))
     assert (out_a["tokens"] == out_b["tokens"]).all()
+
+
+def test_quant_prefill_uses_exact_kv():
+    """Prefill against an int8 cache must attend the exact
+    pre-quantization prompt K/V regardless of prompt length (the flash
+    gcd gate only covers some lengths); quantization error enters only
+    through later cache READS, so prefill logits match the fp cache's
+    prefill exactly."""
+    import dataclasses
+
+    cfg = TransformerConfig(vocab_size=97, num_layers=2, num_heads=2,
+                            d_model=32, d_ff=64, max_seq_len=64,
+                            dtype=jnp.float32)
+    model = Transformer(cfg)
+    # 13 is coprime with 1024: the awkward-length dense prefill path
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 13), 0, 97)
+    variables = model.init(jax.random.PRNGKey(1), tokens)
+    c_fp = init_cache(cfg, 2, 32)
+    c_q8 = init_cache(cfg, 2, 32, quantized=True)
+    lg_fp, _ = model.apply(variables, tokens, c_fp, 0,
+                           method=Transformer.decode)
+    lg_q8, _ = model.apply(variables, tokens, c_q8, 0,
+                           method=Transformer.decode)
+    # not bitwise: the fp cache's prefill sums masked scores over the
+    # full cache_len while the exact-k/v path sums over the prompt only
+    # — pure f32 reduction-order noise (~1e-6), nothing like the
+    # length-dependent quantization error this test guards against
+    # (which measures ~1e-2 at this config)
+    np.testing.assert_allclose(np.asarray(lg_q8), np.asarray(lg_fp),
+                               rtol=1e-5, atol=1e-5)
