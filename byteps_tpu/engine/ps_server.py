@@ -221,8 +221,26 @@ class ServerProfiler:
     def close(self) -> None:
         """Drain and terminate the JSON array (valid strict JSON)."""
         self.flush()
+        import json
+
         with self._io_lock:
             self._closed = True
+            # last-chance drain INSIDE the io lock: a record() batch
+            # appended after flush()'s swap (too small to trip the
+            # autoflush) would otherwise stay buffered forever with no
+            # drop log — write it before terminating the array (the
+            # _closed flag set above makes any batch still racing
+            # toward _write() drop loudly instead of corrupting the
+            # closed file)
+            with self._lock:
+                stragglers, self._events = self._events, []
+            if stragglers:
+                mode = "a" if self._written else "w"
+                with open(self._path, mode) as f:
+                    for ev in stragglers:
+                        f.write(("[\n" if not self._written else ",\n")
+                                + json.dumps(ev))
+                        self._written = True
             if self._written:
                 with open(self._path, "a") as f:
                     f.write("\n]\n")

@@ -70,7 +70,10 @@ def classify_divergence(model: Transformer, variables, prompt,
     agree = float((toks_a == toks_b).mean())
     if (toks_a == toks_b).all():
         return {"divergence": "none", "agreement": 1.0,
-                "first_div_pos": -1, "delta_logit": 0.0}
+                "first_div_pos": -1, "delta_logit": 0.0,
+                "tie_threshold": 0.0,
+                "first_div_positions": [-1] * B,
+                "div_frac_by_quarter": ([0.0] * 4 if N >= 4 else [])}
     # Position profile of the disagreements (r4 verdict #9): a raw 0.64
     # agreement cannot distinguish "near-tie churn spread over late
     # positions" (benign: once one near-tie flips, the contexts
@@ -98,10 +101,9 @@ def classify_divergence(model: Transformer, variables, prompt,
              "div_frac_by_quarter": quarters}
     rank = {"none": 0, "tie": 1, "real": 2}
     for b in range(B):
-        div = np.nonzero(toks_a[b] != toks_b[b])[0]
-        if not len(div):
+        d = first_divs[b]
+        if d < 0:
             continue
-        d = int(div[0])
         # logits that produced generated token d live at sequence
         # position T + d - 1 (the previous token's output)
         row = logits[b, T + d - 1]

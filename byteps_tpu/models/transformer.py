@@ -801,6 +801,19 @@ def init_cache(cfg: TransformerConfig, batch_size: int, max_len: int,
       chunk size; grouped otherwise (CPU tests keep the dense path —
       interpret-mode Pallas per decode step would crawl).
 
+    **Tensor-parallel decode**: when ``cfg.mesh`` carries an active tp
+    axis that divides ``kv_heads``, the grouped cache is sharded over
+    its head axis (``P(dp?, None, tp, ...)``) — each tp shard then
+    holds, writes, and streams only its own KV heads, so serving a
+    model too big for one chip splits the cache (and its decode HBM
+    stream) the same way it splits the weights; the o-projection's
+    row-parallel annotation gives GSPMD the psum that merges the
+    per-shard attention outputs.  When tp does not divide ``kv_heads``
+    (MQA under tp), the cache stays replicated, matching the
+    replicated k/v kernels ``Attention`` falls back to.  See
+    docs/inference.md "Serving topology" for when dp- vs tp-sharding
+    wins.
+
     ``quantized=True`` builds an int8 grouped cache (s8 K/V plus f32
     per-(position, head) scales): half the HBM bytes per decode step,
     quantization happens at write time inside ``Attention``.  Unwritten
@@ -838,14 +851,45 @@ def init_cache(cfg: TransformerConfig, batch_size: int, max_len: int,
         )
     shape = (batch_size, max_len, KV, D)
     if quantized:
-        return tuple(
-            {"k": jnp.zeros(shape, jnp.int8),
-             "v": jnp.zeros(shape, jnp.int8),
-             "k_scale": jnp.zeros(shape[:3], jnp.float32),
-             "v_scale": jnp.zeros(shape[:3], jnp.float32)}
-            for _ in range(cfg.num_layers)
-        )
-    return tuple(
-        {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
-        for _ in range(cfg.num_layers)
-    )
+        layer = lambda: {  # noqa: E731
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(shape[:3], jnp.float32),
+            "v_scale": jnp.zeros(shape[:3], jnp.float32)}
+    else:
+        layer = lambda: {"k": jnp.zeros(shape, cfg.dtype),  # noqa: E731
+                         "v": jnp.zeros(shape, cfg.dtype)}
+    shard = _grouped_cache_sharding(cfg, batch_size)
+    return tuple(shard(layer()) for _ in range(cfg.num_layers))
+
+
+def _grouped_cache_sharding(cfg: TransformerConfig, batch_size: int):
+    """Constraint mapping a grouped cache layer onto ``cfg.mesh`` for
+    tensor-parallel decode (identity when no active tp axis divides the
+    kv heads).  The head axis shards over tp so each shard streams only
+    its own KV heads per step; the batch axis rides dp when it divides
+    evenly.  Applied with ``with_sharding_constraint`` so one code path
+    serves both eager cache construction and the jitted generate loop."""
+    mesh = cfg.mesh
+    if mesh is None:
+        return lambda layer: layer
+    names = mesh.axis_names
+    tp = (cfg.tp_axis if cfg.tp_axis in names
+          and mesh.shape[cfg.tp_axis] > 1
+          and cfg.kv_heads % mesh.shape[cfg.tp_axis] == 0 else None)
+    dp = (cfg.dp_axis if cfg.dp_axis in names
+          and mesh.shape[cfg.dp_axis] > 1
+          and batch_size % mesh.shape[cfg.dp_axis] == 0 else None)
+    if tp is None and dp is None:
+        return lambda layer: layer
+    from jax.sharding import NamedSharding
+
+    spec = {"k": P(dp, None, tp, None), "v": P(dp, None, tp, None),
+            "k_scale": P(dp, None, tp), "v_scale": P(dp, None, tp)}
+
+    def shard(layer):
+        return {name: jax.lax.with_sharding_constraint(
+                    val, NamedSharding(mesh, spec[name]))
+                for name, val in layer.items()}
+
+    return shard

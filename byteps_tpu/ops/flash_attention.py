@@ -51,6 +51,18 @@ from ._pallas_utils import fit_block as _fit_block_impl, resolve_interpret
 # short sequences.
 DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
+# The two backward kernels tune independently of the forward (r4 verdict
+# #6) — each carries three live [BQ, BK] fp32 temps (s, dp, ds) where the
+# fwd holds one, so a different optimum was plausible.  The on-chip
+# per-kernel sweep (scripts/flash_bwd_sweep.py) found 1024x1024 optimal
+# for BOTH anyway (every smaller/rectangular shape loses 2-70%, larger
+# VMEM-fails), and that by executed-dot count the bwd already runs at
+# 0.61 of peak vs the fwd's 0.65 — the machinery stays so a future chip
+# can retune per kernel.  Applied only when the caller left
+# block_q/block_k at the fwd defaults (an explicit caller choice is
+# respected for all three kernels).
+DEFAULT_BWD_DQ_BLOCKS = (1024, 1024)   # (block_q, block_k) of _bwd_dq
+DEFAULT_BWD_DKV_BLOCKS = (1024, 1024)  # (block_q, block_k) of _bwd_dkv
 _NEG_INF = -1e30
 
 
@@ -493,12 +505,17 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref, *rest,
 
 def _flash_backward(q, k, v, o, lse, do, dlse, causal, scale, block_q,
                     block_k, interpret, segment_ids=None, window=None,
-                    alibi_slopes=None):
+                    alibi_slopes=None, dq_blocks=None, dkv_blocks=None):
     """Shared Pallas backward.  ``dlse`` (``[BH, T, 1]`` or None) is the
     cotangent of the log-sum-exp output: since d(lse)/d(s) = softmax(s),
     it folds into the kernels as ``ds = p * (dp - (delta - dlse))`` — the
     same two kernels serve both ``flash_attention`` and the
     lse-returning variant ring attention differentiates through.
+
+    ``dq_blocks``/``dkv_blocks`` override (block_q, block_k) per kernel —
+    the two kernels' VMEM pressure differs (3 live [BQ, BK] fp32 temps
+    each, but different stationary operands), so they tune independently
+    (scripts/flash_bwd_sweep.py; r4 verdict #6).
 
     GQA backward materializes per-q-head k/v (one [B, T, H, D] transient
     each — the forward stays repeat-free) and group-sums dk/dv back to
@@ -512,8 +529,10 @@ def _flash_backward(q, k, v, o, lse, do, dlse, causal, scale, block_q,
         k = jnp.repeat(k, group, axis=2)
         v = jnp.repeat(v, group, axis=2)
     scale = scale if scale is not None else D ** -0.5
-    bq = _fit_block(block_q, T)
-    bk = _fit_block(block_k, T)
+    bq1, bk1 = dq_blocks if dq_blocks is not None else (block_q, block_k)
+    bq2, bk2 = dkv_blocks if dkv_blocks is not None else (block_q, block_k)
+    bq1, bk1 = _fit_block(bq1, T), _fit_block(bk1, T)
+    bq2, bk2 = _fit_block(bq2, T), _fit_block(bk2, T)
 
     # fold batch & heads: [B, T, H, D] -> [BH, T, D]
     def fold(x):
@@ -527,25 +546,25 @@ def _flash_backward(q, k, v, o, lse, do, dlse, causal, scale, block_q,
         delta = delta - dlse
     lse3 = lse[..., None]                            # [BH, T, 1]
 
-    nk = T // bk
-    nq = T // bq
+    nk1, nq1 = T // bk1, T // bq1
+    nk2, nq2 = T // bk2, T // bq2
     arb = pltpu.CompilerParams(
         dimension_semantics=("parallel", "parallel", "arbitrary"))
 
     if causal:
         def kv_idx(b, i, j):
-            jj = jnp.minimum(j, _causal_last_k(i, bq, bk, nk))
+            jj = jnp.minimum(j, _causal_last_k(i, bq1, bk1, nk1))
             if window is not None:
-                jj = jnp.maximum(jj, _window_first_k(i, bq, bk, window))
+                jj = jnp.maximum(jj, _window_first_k(i, bq1, bk1, window))
             return (b, jj, 0)
 
         def q_idx(b, ki, i):  # clamp from below: first useful q block
-            ii = jnp.maximum(i, (ki * bk) // bq)
+            ii = jnp.maximum(i, (ki * bk2) // bq2)
             if window is not None:
                 # clamp from above: last q block inside the band
                 ii = jnp.minimum(
                     ii, jnp.minimum(
-                        (ki * bk + bk - 1 + window - 1) // bq, nq - 1))
+                        (ki * bk2 + bk2 - 1 + window - 1) // bq2, nq2 - 1))
             return (b, ii, 0)
     else:
         def kv_idx(b, i, j):
@@ -563,12 +582,12 @@ def _flash_backward(q, k, v, o, lse, do, dlse, causal, scale, block_q,
                             B)[:, None, None]            # [B*H, 1, 1]
 
     dq_specs = [
-        pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),  # q block
-        pl.BlockSpec((1, bk, D), kv_idx),                     # k block
-        pl.BlockSpec((1, bk, D), kv_idx),                     # v block
-        pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),  # do block
-        pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),  # lse block
-        pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),  # delta
+        pl.BlockSpec((1, bq1, D), lambda b, i, j: (b, i, 0)),  # q block
+        pl.BlockSpec((1, bk1, D), kv_idx),                     # k block
+        pl.BlockSpec((1, bk1, D), kv_idx),                     # v block
+        pl.BlockSpec((1, bq1, D), lambda b, i, j: (b, i, 0)),  # do block
+        pl.BlockSpec((1, bq1, 1), lambda b, i, j: (b, i, 0)),  # lse block
+        pl.BlockSpec((1, bq1, 1), lambda b, i, j: (b, i, 0)),  # delta
     ]
     dq_ops = [qf, kf, vf, dof, lse3, delta]
     if has_seg:
@@ -577,8 +596,8 @@ def _flash_backward(q, k, v, o, lse, do, dlse, causal, scale, block_q,
             return (b // H, ji, 0)
 
         dq_specs += [
-            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b // H, i, 0)),
-            pl.BlockSpec((1, bk, 1), skv_idx),
+            pl.BlockSpec((1, bq1, 1), lambda b, i, j: (b // H, i, 0)),
+            pl.BlockSpec((1, bk1, 1), skv_idx),
         ]
         dq_ops += [seg, seg]
     if has_alibi:
@@ -586,25 +605,25 @@ def _flash_backward(q, k, v, o, lse, do, dlse, causal, scale, block_q,
         dq_ops += [slopes_f]
 
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, nk=nk, causal=causal, scale=scale,
+        functools.partial(_bwd_dq_kernel, nk=nk1, causal=causal, scale=scale,
                           has_seg=has_seg, has_alibi=has_alibi,
                           window=window),
-        grid=(B * H, nq, nk),
+        grid=(B * H, nq1, nk1),
         in_specs=dq_specs,
-        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, bq1, D), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
-        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bq1, D), jnp.float32)],
         compiler_params=arb,
         interpret=interpret,
     )(*dq_ops)
 
     dkv_specs = [
-        pl.BlockSpec((1, bk, D), lambda b, ki, i: (b, ki, 0)),  # k block
-        pl.BlockSpec((1, bk, D), lambda b, ki, i: (b, ki, 0)),  # v block
-        pl.BlockSpec((1, bq, D), q_idx),                        # q block
-        pl.BlockSpec((1, bq, D), q_idx),                        # do block
-        pl.BlockSpec((1, bq, 1), q_idx),                        # lse
-        pl.BlockSpec((1, bq, 1), q_idx),                        # delta
+        pl.BlockSpec((1, bk2, D), lambda b, ki, i: (b, ki, 0)),  # k block
+        pl.BlockSpec((1, bk2, D), lambda b, ki, i: (b, ki, 0)),  # v block
+        pl.BlockSpec((1, bq2, D), q_idx),                        # q block
+        pl.BlockSpec((1, bq2, D), q_idx),                        # do block
+        pl.BlockSpec((1, bq2, 1), q_idx),                        # lse
+        pl.BlockSpec((1, bq2, 1), q_idx),                        # delta
     ]
     dkv_ops = [kf, vf, qf, dof, lse3, delta]
     if has_seg:
@@ -613,8 +632,8 @@ def _flash_backward(q, k, v, o, lse, do, dlse, causal, scale, block_q,
             return (b // H, ii, 0)
 
         dkv_specs += [
-            pl.BlockSpec((1, bk, 1), lambda b, ki, i: (b // H, ki, 0)),
-            pl.BlockSpec((1, bq, 1), sq_idx),
+            pl.BlockSpec((1, bk2, 1), lambda b, ki, i: (b // H, ki, 0)),
+            pl.BlockSpec((1, bq2, 1), sq_idx),
         ]
         dkv_ops += [seg, seg]
     if has_alibi:
@@ -622,22 +641,22 @@ def _flash_backward(q, k, v, o, lse, do, dlse, causal, scale, block_q,
         dkv_ops += [slopes_f]
 
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, nq=nq, causal=causal, scale=scale,
+        functools.partial(_bwd_dkv_kernel, nq=nq2, causal=causal, scale=scale,
                           has_seg=has_seg, has_alibi=has_alibi,
                           window=window),
-        grid=(B * H, nk, nq),
+        grid=(B * H, nk2, nq2),
         in_specs=dkv_specs,
         out_specs=[
-            pl.BlockSpec((1, bk, D), lambda b, ki, i: (b, ki, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, ki, i: (b, ki, 0)),
+            pl.BlockSpec((1, bk2, D), lambda b, ki, i: (b, ki, 0)),
+            pl.BlockSpec((1, bk2, D), lambda b, ki, i: (b, ki, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, T, D), k.dtype),
             jax.ShapeDtypeStruct((B * H, T, D), v.dtype),
         ],
         scratch_shapes=[
-            pltpu.VMEM((bk, D), jnp.float32),
-            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk2, D), jnp.float32),
+            pltpu.VMEM((bk2, D), jnp.float32),
         ],
         compiler_params=arb,
         interpret=interpret,
@@ -655,13 +674,25 @@ def _flash_backward(q, k, v, o, lse, do, dlse, causal, scale, block_q,
     return dq_out, dk_out, dv_out
 
 
+def _bwd_blocks(block_q, block_k):
+    """Per-kernel bwd block shapes: the swept defaults when the caller
+    left (block_q, block_k) at the fwd-tuned defaults, else the caller's
+    explicit choice for both kernels (a VMEM-forced small block must
+    bind the bwd too)."""
+    if (block_q, block_k) == (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K):
+        return DEFAULT_BWD_DQ_BLOCKS, DEFAULT_BWD_DKV_BLOCKS
+    return (block_q, block_k), (block_q, block_k)
+
+
 def _bwd_rule(causal, scale, block_q, block_k, interpret, window, res, do):
     import numpy as np
 
     q, k, v, o, lse, segment_ids, alibi_slopes = res
+    dq_b, dkv_b = _bwd_blocks(block_q, block_k)
     dq, dk, dv = _flash_backward(q, k, v, o, lse, do, None, causal, scale,
                                  block_q, block_k, interpret, segment_ids,
-                                 window, alibi_slopes)
+                                 window, alibi_slopes,
+                                 dq_blocks=dq_b, dkv_blocks=dkv_b)
     dseg = (None if segment_ids is None
             else np.zeros(segment_ids.shape, jax.dtypes.float0))
     # slopes are constants by contract (see flash_attention docstring)
@@ -719,8 +750,10 @@ def _lse_bwd_rule(causal, scale, block_q, block_k, interpret, res, cts):
         # [B, T, H] -> [BH, T, 1]
         dlse3 = dlse.transpose(0, 2, 1).reshape(B * H, T)[..., None]
         dlse3 = dlse3.astype(jnp.float32)
+    dq_b, dkv_b = _bwd_blocks(block_q, block_k)
     return _flash_backward(q, k, v, o, lse_bh, do, dlse3, causal, scale,
-                           block_q, block_k, interpret)
+                           block_q, block_k, interpret,
+                           dq_blocks=dq_b, dkv_blocks=dkv_b)
 
 
 flash_attention_with_lse.defvjp(_lse_fwd_rule, _lse_bwd_rule)

@@ -52,6 +52,10 @@ def main():
         final = [None] * args.workers
         errors = [None] * args.workers
 
+        # per-worker phase accounting (r4 verdict #8: find the 4-11%):
+        # [local compute, exchange wait/adopt on the train thread]
+        phase = [[0.0, 0.0] for _ in range(args.workers)]
+
         def work(idx, w):
             # any exception is captured and re-raised on the main thread:
             # a dead worker must fail the benchmark loudly, not surface
@@ -61,6 +65,8 @@ def main():
                 for it in range(args.steps):
                     t0 = time.perf_counter()
                     params = params - lr * (params - target)   # local step
+                    t1 = time.perf_counter()
+                    phase[idx][0] += t1 - t0
                     if (it + 1) % args.interval == 0:
                         if mode == "sync":
                             pulled = w.push_pull({"w": jnp.asarray(params)})
@@ -70,6 +76,7 @@ def main():
                                 pulled, sub = w.take_result()
                                 params = params + (pulled["w"] - sub["w"])
                             w.begin_push_pull({"w": jnp.asarray(params)})
+                        phase[idx][1] += time.perf_counter() - t1
                     worst_step[idx] = max(worst_step[idx],
                                           time.perf_counter() - t0)
                 if mode != "sync" and w.exchange_in_flight():
@@ -101,6 +108,12 @@ def main():
             "final_max_err": round(err, 4),
             "workers": args.workers,
             "exchange_latency_ms": args.latency_ms,
+            # where the wall time went, summed over workers: local = the
+            # numpy "train" step; exchange = train-thread time inside the
+            # exchange block (sync: the full blocking push_pull;
+            # pipelined: take_result wait + catch-up adopt + begin)
+            "local_compute_sec": round(sum(p[0] for p in phase), 3),
+            "exchange_thread_sec": round(sum(p[1] for p in phase), 3),
         }
 
     sync = run("sync")

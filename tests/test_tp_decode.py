@@ -1,0 +1,138 @@
+"""Tensor-parallel KV-cache decode (VERDICT r4 #5).
+
+The serving topology for models that don't fit one chip: attention heads
+(and kv heads) shard over a tp mesh axis through init_cache / decode /
+generate, with GSPMD inserting the o-projection psum from the
+row-parallel kernel annotation.  Ground truth is single-device
+generation on the same parameter values — tp must change placement,
+never tokens.
+
+The reference has no model-dimension partitioning at all (SURVEY.md
+§2.4 "Not present"); this is the TPU-native extension of its
+data-parallel-only design.
+"""
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from byteps_tpu.inference import generate, quantize_params
+from byteps_tpu.models.transformer import (
+    Transformer,
+    TransformerConfig,
+    init_cache,
+)
+
+
+def _build(mesh, **kw):
+    kw = {"num_kv_heads": 2, **kw}
+    cfg = TransformerConfig(
+        vocab_size=61, num_layers=2, num_heads=4,
+        d_model=32, d_ff=64, max_seq_len=64, dtype=jnp.float32,
+        pos_emb="rope", mlp="swiglu", mesh=mesh, **kw)
+    return cfg, Transformer(cfg)
+
+
+def _sharded_params(cfg, model, mesh, prompt):
+    """Init (boxed under the mesh cfg), then place per the tp specs."""
+    boxed = model.init(jax.random.PRNGKey(1), prompt)
+    specs = nn.get_partition_spec(boxed)["params"]
+    params = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        nn.meta.unbox(boxed["params"]), specs)
+    return {"params": params}, {"params": nn.meta.unbox(boxed["params"])}
+
+
+def _reference_tokens(cfg, params, prompt, n_new, **kw):
+    """Single-device greedy generation on the same parameter values."""
+    ref_model = Transformer(dataclasses.replace(cfg, mesh=None))
+    return np.asarray(
+        generate(ref_model, params, prompt, n_new, temperature=0,
+                 **kw)["tokens"])
+
+
+def test_tp_generate_matches_single_device():
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    cfg, model = _build(mesh)
+    prompt = jax.random.randint(jax.random.PRNGKey(0), (2, 9), 0, 61)
+    tp_vars, ref_vars = _sharded_params(cfg, model, mesh, prompt)
+    got = np.asarray(
+        generate(model, tp_vars, prompt, 8, temperature=0)["tokens"])
+    want = _reference_tokens(cfg, ref_vars, prompt, 8)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_tp_cache_is_head_sharded():
+    """The grouped cache shards its kv-head axis over tp — each shard
+    holds (and streams) only its own heads, the point of tp serving."""
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    cfg, _ = _build(mesh)
+    caches = init_cache(cfg, 2, 16)
+    k = caches[0]["k"]
+    assert k.shape == (2, 16, 2, 8)
+    spec = k.sharding.spec
+    assert spec[2] == "tp", f"kv-head axis not tp-sharded: {spec}"
+
+
+def test_dp_x_tp_generate_matches_single_device():
+    """The full serving mesh: batch over dp, heads over tp."""
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "tp"))
+    cfg, model = _build(mesh)
+    prompt = jax.random.randint(jax.random.PRNGKey(0), (4, 7), 0, 61)
+    tp_vars, ref_vars = _sharded_params(cfg, model, mesh, prompt)
+    prompt_sh = jax.device_put(prompt, NamedSharding(mesh, P("dp", None)))
+    got = np.asarray(
+        generate(model, tp_vars, prompt_sh, 6, temperature=0)["tokens"])
+    want = _reference_tokens(cfg, ref_vars, prompt, 6)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_tp_mqa_replicated_kv_matches_single_device():
+    """kv_heads=1 under tp=2: tp does not divide the kv heads, so the
+    k/v kernels and the cache stay replicated (the Megatron MQA
+    treatment) — correctness must be unaffected."""
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    cfg, model = _build(mesh, num_kv_heads=1)
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 8), 0, 61)
+    tp_vars, ref_vars = _sharded_params(cfg, model, mesh, prompt)
+    caches = init_cache(cfg, 2, 16)
+    assert caches[0]["k"].shape[2] == 1
+    got = np.asarray(
+        generate(model, tp_vars, prompt, 6, temperature=0)["tokens"])
+    want = _reference_tokens(cfg, ref_vars, prompt, 6)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_tp_int8_kv_cache_matches_single_device():
+    """The int8 KV cache composes with tp: quantized grouped cache
+    shards its head axis, the mixed s8 dots run per shard."""
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    cfg, model = _build(mesh)
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 8), 0, 61)
+    tp_vars, ref_vars = _sharded_params(cfg, model, mesh, prompt)
+    got = np.asarray(
+        generate(model, tp_vars, prompt, 6, temperature=0,
+                 kv_quant=True)["tokens"])
+    want = _reference_tokens(cfg, ref_vars, prompt, 6, kv_quant=True)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_tp_int8_weights_generate_runs():
+    """int8 weight-only quantization of a tp-sharded tree keeps the
+    partition metadata (quantize_params re-boxes), and generation under
+    tp still matches the single-device int8 decode."""
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    cfg, model = _build(mesh)
+    prompt = jax.random.randint(jax.random.PRNGKey(4), (2, 8), 0, 61)
+    tp_vars, ref_vars = _sharded_params(cfg, model, mesh, prompt)
+    qtree = {"params": quantize_params(tp_vars["params"])}
+    got = np.asarray(
+        generate(model, qtree, prompt, 5, temperature=0)["tokens"])
+    ref_q = {"params": quantize_params(ref_vars["params"])}
+    want = _reference_tokens(cfg, ref_q, prompt, 5)
+    np.testing.assert_array_equal(got, want)
