@@ -1074,8 +1074,6 @@ def main():
     tr_cfg = dataclasses.replace(gcfg, pos_emb="rope",
                                  max_seq_len=CL + 40)
     tr_model = _Tfm(tr_cfg)
-    tr_early_cfg = dataclasses.replace(tr_cfg, num_layers=EARLY)
-    tr_early_model = _Tfm(tr_early_cfg)
     # fresh f32 master for training; the decode rows then run on its
     # bf16 cast, like deployment would
     tr_master = tr_model.init(jax.random.PRNGKey(12), gprompt)["params"]
@@ -1084,35 +1082,33 @@ def main():
     tr_opt = tr_tx.init(tr_master)
     tr_B, tr_T = (32, 128) if on_tpu else (8, 16)
 
+    # the framework's LayerSkip training mode: full CE + weighted CE of
+    # the first-EARLY-layers exit (training.lm_loss_fn early_exit= —
+    # the same truncation speculative_generate runs at decode time)
+    from byteps_tpu.training import lm_loss_fn as _lm_loss_fn
+
+    tr_loss_fn = _lm_loss_fn(tr_model, early_exit=(EARLY, 0.5))
+    tr_full_fn = _lm_loss_fn(tr_model)
+
     @jax.jit
     def _tr_step(params, opt_state, toks):
         def loss_of(p):
-            logits = tr_model.apply({"params": p}, toks)
-            tgt = toks[:, 1:]
-            full = optax.softmax_cross_entropy_with_integer_labels(
-                logits[:, :-1], tgt).mean()
-            # the SAME truncation speculative_generate will run: reusing
-            # truncated_draft (works under trace — it only filters the
-            # pytree) keeps the trained early exit and the runtime draft
-            # in lockstep by construction
-            _, early_vars = truncated_draft(tr_cfg, {"params": p}, EARLY)
-            elogits = tr_early_model.apply(early_vars, toks)
-            early = optax.softmax_cross_entropy_with_integer_labels(
-                elogits[:, :-1], tgt).mean()
-            return full + 0.5 * early, full
+            return tr_loss_fn(p, {}, {"tokens": toks})[0]
 
-        (loss, full), grads = jax.value_and_grad(
-            loss_of, has_aux=True)(params)
+        loss, grads = jax.value_and_grad(loss_of)(params)
         updates, opt_state = tr_tx.update(grads, opt_state)
-        return optax.apply_updates(params, updates), opt_state, full
+        return optax.apply_updates(params, updates), opt_state
 
     tr_rng = jax.random.PRNGKey(77)
-    tr_loss = None
+    last_toks = None
     for _ in range(tr_steps):
         tr_rng, sub = jax.random.split(tr_rng)
-        tr_master, tr_opt, tr_loss = _tr_step(
-            tr_master, tr_opt, _pattern_batch(sub, tr_B, tr_T))
-    tr_loss = float(tr_loss)
+        last_toks = _pattern_batch(sub, tr_B, tr_T)
+        tr_master, tr_opt = _tr_step(tr_master, tr_opt, last_toks)
+    # report the full-model CE once, after training (the aux term would
+    # inflate the in-loop loss, and a per-step reporting forward would
+    # pay an extra full pass 600x)
+    tr_loss = float(tr_full_fn(tr_master, {}, {"tokens": last_toks})[0])
     del tr_opt
     tr_vars = {"params": jax.tree_util.tree_map(
         lambda x: x.astype(gcfg.dtype)

@@ -184,6 +184,19 @@ class ServerProfiler:
         if drained:
             self._write(drained)
 
+    def _append_locked(self, events: List[dict]) -> None:
+        """Append events to the JSON array on disk.  Caller must hold
+        ``_io_lock`` — the '['/',' separator protocol and ``_written``
+        bookkeeping live only here so every append path shares them."""
+        import json
+
+        mode = "a" if self._written else "w"
+        with open(self._path, mode) as f:
+            for ev in events:
+                f.write(("[\n" if not self._written else ",\n")
+                        + json.dumps(ev))
+                self._written = True
+
     def _write(self, events: List[dict]) -> None:
         """Append drained events to the file (``_io_lock`` serializes
         concurrent drains so appends stay ordered).  Flushes are O(new
@@ -191,8 +204,6 @@ class ServerProfiler:
         chrome-trace JSON array kept loadable mid-run by the viewer's
         documented leniency about a missing closing bracket; ``close()``
         terminates it properly."""
-        import json
-
         with self._io_lock:
             if self._closed:
                 # a record() thread swapped its batch out just as
@@ -203,12 +214,7 @@ class ServerProfiler:
                     "ps_server profiler: dropping %d events raced "
                     "against close()", len(events))
                 return
-            mode = "a" if self._written else "w"
-            with open(self._path, mode) as f:
-                for ev in events:
-                    f.write(("[\n" if not self._written else ",\n")
-                            + json.dumps(ev))
-                    self._written = True
+            self._append_locked(events)
         bps_log.debug("ps_server profiler: +%d events -> %s",
                       len(events), self._path)
 
@@ -221,8 +227,6 @@ class ServerProfiler:
     def close(self) -> None:
         """Drain and terminate the JSON array (valid strict JSON)."""
         self.flush()
-        import json
-
         with self._io_lock:
             self._closed = True
             # last-chance drain INSIDE the io lock: a record() batch
@@ -235,12 +239,7 @@ class ServerProfiler:
             with self._lock:
                 stragglers, self._events = self._events, []
             if stragglers:
-                mode = "a" if self._written else "w"
-                with open(self._path, mode) as f:
-                    for ev in stragglers:
-                        f.write(("[\n" if not self._written else ",\n")
-                                + json.dumps(ev))
-                        self._written = True
+                self._append_locked(stragglers)
             if self._written:
                 with open(self._path, "a") as f:
                     f.write("\n]\n")

@@ -187,9 +187,23 @@ def replicate_state(state, mesh: Mesh):
 
 
 def lm_loss_fn(model, fused_head: bool = False,
-               block_n: Optional[int] = None, block_v: Optional[int] = None):
+               block_n: Optional[int] = None, block_v: Optional[int] = None,
+               early_exit: Optional[tuple] = None):
     """Next-token cross-entropy loss closure for a causal LM whose batch
     is ``{"tokens": [B, T]}``; fits ``make_data_parallel_step``.
+
+    ``early_exit=(layers, weight)`` adds the LayerSkip auxiliary loss:
+    ``weight * CE(first-`layers` exit)`` where the exit is the model's
+    own ``ln_f`` + head applied to the truncated depth — exactly the
+    truncation ``inference.truncated_draft`` builds, so a model trained
+    with this term accepts its own truncated self-draft under
+    speculative decoding.  Without it the early-exit readout is
+    untrained and the draft is useless no matter how well the full
+    model converges (measured: acceptance ~0.002 on a converged
+    vanilla-trained 12L model vs 0.70-0.88 with the term — see
+    bench.py's trained-speculative row).  Requires a
+    ``models.transformer.Transformer`` (the truncation slices its
+    ``block_i`` param subtree).
 
     ``fused_head=True`` routes through the Pallas fused LM-head kernel
     (ops/fused_cross_entropy.py): the model's ``hidden`` method supplies
@@ -210,6 +224,51 @@ def lm_loss_fn(model, fused_head: bool = False,
     neither loss nor denominator, in both the fused and plain branches.
     """
 
+    def _head_weight(params, h):
+        if "lm_head" in params:
+            return params["lm_head"]["kernel"].astype(h.dtype)
+        # tied-embedding models (tie_embeddings=True) have no
+        # lm_head; the head weight is the embedding transposed.
+        # tp-partitioned trees box the leaf in nn.Partitioned.
+        import flax.linen as nn
+
+        emb = params["embed"]["embedding"]
+        if isinstance(emb, nn.meta.AxisMetadata):
+            emb = emb.unbox()
+        return emb.T.astype(h.dtype)
+
+    def _fused_ce(params, m, tokens, targets):
+        from ..ops.fused_cross_entropy import fused_linear_cross_entropy
+
+        h = m.apply({"params": params}, tokens, method=m.hidden)
+        w = _head_weight(params, h)
+        B, T, d = h.shape
+        V = w.shape[-1]
+        flat_t = targets.reshape(-1)
+        per_row = fused_linear_cross_entropy(
+            h.reshape(-1, d), w, flat_t, block_n, block_v,
+        )
+        # mean over *valid* targets only: with padded token streams
+        # (HF -100 convention) a fixed B*(T-1) denominator deflates
+        # the loss; the kernel already zeroes ignored rows
+        valid = jnp.sum((flat_t >= 0) & (flat_t < V))
+        return per_row.sum() / jnp.maximum(valid, 1).astype(per_row.dtype)
+
+    def _plain_ce(params, m, tokens, targets):
+        logits = m.apply({"params": params}, tokens)
+        t = targets[:, :-1]
+        valid = (t >= 0) & (t < logits.shape[-1])
+        # optax's integer-label CE has no ignore-index: out-of-range
+        # labels produce garbage — clamp them and zero their loss
+        per_tok = optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], jnp.where(valid, t, 0)
+        )
+        per_tok = jnp.where(valid, per_tok, 0.0)
+        return per_tok.sum() / jnp.maximum(valid.sum(), 1).astype(
+            per_tok.dtype)
+
+    ce = _fused_ce if fused_head else _plain_ce
+
     def loss_fn(params, model_state, batch):
         tokens = batch["tokens"]
         if "labels" in batch:
@@ -219,45 +278,19 @@ def lm_loss_fn(model, fused_head: bool = False,
         else:
             targets = jnp.roll(tokens, -1, axis=1)
         targets = targets.at[:, -1].set(-100)  # ignore the wrap position
-        if fused_head:
-            from ..ops.fused_cross_entropy import fused_linear_cross_entropy
+        loss = ce(params, model, tokens, targets)
+        if early_exit is not None:
+            from ..inference import truncated_draft
 
-            h = model.apply({"params": params}, tokens, method=model.hidden)
-            if "lm_head" in params:
-                w = params["lm_head"]["kernel"].astype(h.dtype)
-            else:
-                # tied-embedding models (tie_embeddings=True) have no
-                # lm_head; the head weight is the embedding transposed.
-                # tp-partitioned trees box the leaf in nn.Partitioned.
-                import flax.linen as nn
-
-                emb = params["embed"]["embedding"]
-                if isinstance(emb, nn.meta.AxisMetadata):
-                    emb = emb.unbox()
-                w = emb.T.astype(h.dtype)
-            B, T, d = h.shape
-            V = w.shape[-1]
-            flat_t = targets.reshape(-1)
-            per_row = fused_linear_cross_entropy(
-                h.reshape(-1, d), w, flat_t, block_n, block_v,
-            )
-            # mean over *valid* targets only: with padded token streams
-            # (HF -100 convention) a fixed B*(T-1) denominator deflates
-            # the loss; the kernel already zeroes ignored rows
-            valid = jnp.sum((flat_t >= 0) & (flat_t < V))
-            loss = per_row.sum() / jnp.maximum(valid, 1).astype(per_row.dtype)
-        else:
-            logits = model.apply({"params": params}, tokens)
-            t = targets[:, :-1]
-            valid = (t >= 0) & (t < logits.shape[-1])
-            # optax's integer-label CE has no ignore-index: out-of-range
-            # labels produce garbage — clamp them and zero their loss
-            per_tok = optax.softmax_cross_entropy_with_integer_labels(
-                logits[:, :-1], jnp.where(valid, t, 0)
-            )
-            per_tok = jnp.where(valid, per_tok, 0.0)
-            loss = per_tok.sum() / jnp.maximum(valid.sum(), 1).astype(
-                per_tok.dtype)
+            e_layers, e_weight = early_exit
+            # truncated_draft only filters the pytree, so it traces
+            # cleanly under jit/grad — and it is the SAME truncation
+            # speculative_generate runs at decode time, keeping the
+            # trained exit and the runtime draft in lockstep
+            dmodel, dvars = truncated_draft(
+                model.cfg, {"params": params}, e_layers)
+            loss = loss + e_weight * ce(
+                dvars["params"], dmodel, tokens, targets)
         return loss, model_state
 
     return loss_fn

@@ -57,14 +57,18 @@ def main():
     tx = optax.adam(3e-3)
     opt_state = tx.init(params)
 
+    # LayerSkip training mode: the auxiliary early-exit CE trains
+    # ln_f+head to read the first layer's output, which is what makes
+    # the 1-layer truncated self-draft below actually get accepted
+    # (docs/inference.md "Free self-drafts need LayerSkip training")
+    from byteps_tpu.training import lm_loss_fn
+
+    loss_closure = lm_loss_fn(model, early_exit=(1, 0.5))
+
     @jax.jit
     def train_step(params, opt_state, toks):
-        def loss_of(p):
-            logits = model.apply({"params": p}, toks)
-            return optax.softmax_cross_entropy_with_integer_labels(
-                logits[:, :-1], toks[:, 1:]).mean()
-
-        loss, grads = jax.value_and_grad(loss_of)(params)
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_closure(p, {}, {"tokens": toks})[0])(params)
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 

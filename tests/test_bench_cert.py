@@ -37,6 +37,10 @@ def _rows():
          "ms_per_token_decode": 0.27},
         {"metric": "generate_decode_B1_T256_int8_tokens_per_sec",
          "value": 4200.0, "unit": "tokens/sec", "vs_baseline": 1.2},
+        {"metric": "generate_decode_int8kv_B32_T2048_tokens_per_sec",
+         "value": 33600.0, "unit": "tokens/sec", "vs_baseline": 1.54},
+        {"metric": "speculative_layerskip_trained_B1_T256_tokens_per_sec",
+         "value": 7100.0, "unit": "tokens/sec", "vs_baseline": 1.98},
     ]
 
 
@@ -55,6 +59,8 @@ def test_certification_line():
     assert kn["decode_b8_ms_tok"] == 0.74
     assert kn["decode_gqa_ms_tok"] == 0.27
     assert kn["decode_b1_int8_vs_bf16"] == 1.2
+    assert kn["int8kv_b32_vs_bf16"] == 1.54
+    assert kn["spec_trained_vs_plain"] == 1.98
     # must survive the driver's ~2000-char tail capture
     assert len(json.dumps(cert)) < 1900
 

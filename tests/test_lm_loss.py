@@ -134,3 +134,77 @@ def test_fully_valid_stream_unchanged_vs_mean():
     want = optax.softmax_cross_entropy_with_integer_labels(
         logits[:, :-1], targets[:, :-1]).mean()
     np.testing.assert_allclose(float(l), float(want), rtol=1e-6)
+
+
+def test_early_exit_loss_equals_full_plus_weighted_truncated():
+    """early_exit=(k, w) adds exactly w * CE of the first-k-layers exit
+    (the truncation truncated_draft builds), in both head paths."""
+    from byteps_tpu.inference import truncated_draft
+
+    cfg = _tiny_cfg()
+    model = Transformer(cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((4, 16), jnp.int32))["params"]
+    batch = {"tokens": tokens}
+
+    base = lm_loss_fn(model)(params, {}, batch)[0]
+    dmodel, dvars = truncated_draft(cfg, {"params": params}, 1)
+    early = lm_loss_fn(dmodel)(dvars["params"], {}, batch)[0]
+    got = lm_loss_fn(model, early_exit=(1, 0.5))(params, {}, batch)[0]
+    np.testing.assert_allclose(float(got), float(base) + 0.5 * float(early),
+                               rtol=1e-5)
+    # fused-head path carries the same aux term
+    got_f = lm_loss_fn(model, fused_head=True,
+                       early_exit=(1, 0.5))(params, {}, batch)[0]
+    np.testing.assert_allclose(float(got_f), float(got), rtol=1e-4)
+
+
+def test_early_exit_training_makes_truncated_draft_viable():
+    """The LayerSkip premise, end to end: vanilla training leaves the
+    early-exit readout (ln_f + head over block_0) untrained, so the
+    truncated self-draft is rejected even by a CONVERGED target; adding
+    the early_exit aux term trains the exit and speculative decoding
+    accepts the draft at a high rate.  (The bench's trained-speculative
+    row rides exactly this mode.)"""
+    from byteps_tpu.inference import speculative_generate, truncated_draft
+
+    cfg = TransformerConfig(vocab_size=64, num_layers=3, num_heads=4,
+                            d_model=64, d_ff=128, max_seq_len=64,
+                            dtype=jnp.float32, pos_emb="rope")
+    model = Transformer(cfg)
+
+    def pattern_batch(key, B=16, T=16):
+        pat = jax.random.randint(key, (B, 4), 3, 64)
+        return jnp.tile(pat, (1, T // 4 + 1))[:, :T]
+
+    def train(loss_closure, steps=250):
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((2, 8), jnp.int32))["params"]
+        tx = optax.adam(3e-3)
+        opt = tx.init(params)
+
+        @jax.jit
+        def step(params, opt, toks):
+            loss, grads = jax.value_and_grad(
+                lambda p: loss_closure(p, {}, {"tokens": toks})[0])(params)
+            upd, opt = tx.update(grads, opt)
+            return optax.apply_updates(params, upd), opt, loss
+
+        rng = jax.random.PRNGKey(7)
+        for _ in range(steps):
+            rng, sub = jax.random.split(rng)
+            params, opt, _ = step(params, opt, pattern_batch(sub))
+        return params
+
+    def acceptance(params):
+        dmodel, dvars = truncated_draft(cfg, {"params": params}, 1)
+        prompt = pattern_batch(jax.random.PRNGKey(99), B=1, T=8)
+        out = speculative_generate(model, {"params": params}, dmodel,
+                                   dvars, prompt, 12, gamma=4)
+        return float(out["acceptance"])
+
+    acc_aux = acceptance(train(lm_loss_fn(model, early_exit=(1, 0.5))))
+    acc_vanilla = acceptance(train(lm_loss_fn(model)))
+    assert acc_aux > 0.5, acc_aux
+    assert acc_aux > acc_vanilla + 0.2, (acc_vanilla, acc_aux)
