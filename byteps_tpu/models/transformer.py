@@ -506,23 +506,53 @@ class Attention(nn.Module):
                 # a PHYSICAL copy of the whole cache every step
                 # (ops/decode_attention.py; measured 3.1x on MHA decode)
                 B_, T_ = x.shape[0], x.shape[1]
-                row_k = k.reshape(B_, T_, KV * D).astype(cache["k"].dtype)
-                row_v = v.reshape(B_, T_, KV * D).astype(cache["v"].dtype)
+                if quant_cache:
+                    # flat int8: quantize at write time (the same
+                    # per-(position, head) scales as the grouped s8
+                    # cache), store the values flat so the fused kernel
+                    # streams s8 bytes copy-free — half the cache HBM
+                    # read on top of the kernel's layout win
+                    kq, ks = _quantize_kv(k)
+                    vq, vs = _quantize_kv(v)
+                    row_k = kq.reshape(B_, T_, KV * D)
+                    row_v = vq.reshape(B_, T_, KV * D)
+                else:
+                    row_k = k.reshape(B_, T_, KV * D).astype(
+                        cache["k"].dtype)
+                    row_v = v.reshape(B_, T_, KV * D).astype(
+                        cache["v"].dtype)
                 ck = jax.lax.dynamic_update_slice(
                     cache["k"], row_k, (0, pos, 0))
                 cv = jax.lax.dynamic_update_slice(
                     cache["v"], row_v, (0, pos, 0))
                 new_cache = {"k": ck, "v": cv}
+                if quant_cache:
+                    cks = jax.lax.dynamic_update_slice(
+                        cache["k_scale"],
+                        ks.astype(cache["k_scale"].dtype), (0, pos, 0))
+                    cvs = jax.lax.dynamic_update_slice(
+                        cache["v_scale"],
+                        vs.astype(cache["v_scale"].dtype), (0, pos, 0))
+                    new_cache = {"k": ck, "v": cv,
+                                 "k_scale": cks, "v_scale": cvs}
                 if prefill_flash:
                     from ..ops.flash_attention import flash_attention
 
+                    # (quant cache: prefill attends the exact pre-
+                    # quantization k/v in hand — only later reads see
+                    # s8, the same contract as the grouped path)
                     out = flash_attention(q, k, v, causal=True,
                                           window=cfg.attn_window)
                 elif T_ == 1:
                     from ..ops.decode_attention import decode_attention
 
-                    out = decode_attention(q, ck, cv, pos,
-                                           window=cfg.attn_window)
+                    if quant_cache:
+                        out = decode_attention(
+                            q, ck, cv, pos, k_scale=cks, v_scale=cvs,
+                            window=cfg.attn_window)
+                    else:
+                        out = decode_attention(q, ck, cv, pos,
+                                               window=cfg.attn_window)
                 elif isinstance(pos, int) and pos == 0:
                     # dense prefill fallback (awkward prompt lengths):
                     # at static pos=0 the valid cache slots are exactly
@@ -534,10 +564,16 @@ class Attention(nn.Module):
                     # tq>1 at pos>0 (speculative verify): dense path
                     # needs the grouped view; pays the one relayout
                     S_ = ck.shape[1]
-                    out = _cached_attention(
-                        q, ck.reshape(B_, S_, KV, D),
-                        cv.reshape(B_, S_, KV, D), pos,
-                        window=cfg.attn_window)
+                    if quant_cache:
+                        out = _cached_attention_q8(
+                            q, ck.reshape(B_, S_, KV, D), cks,
+                            cv.reshape(B_, S_, KV, D), cvs, pos,
+                            window=cfg.attn_window)
+                    else:
+                        out = _cached_attention(
+                            q, ck.reshape(B_, S_, KV, D),
+                            cv.reshape(B_, S_, KV, D), pos,
+                            window=cfg.attn_window)
                 return o_proj(out), new_cache
             if quant_cache:
                 # int8 KV cache: K/V quantize at write time (per
@@ -794,12 +830,19 @@ def init_cache(cfg: TransformerConfig, batch_size: int, max_len: int,
       layout consumed by the fused Pallas decode kernel
       (ops/decode_attention.py) with zero per-step relayout.  Measured
       3.1x (MHA) / 1.4x (GQA kv=2) over the dense path at T=1024.
+      With ``quantized=True`` the flat cache stores s8 values plus the
+      per-(position, head) scales and the kernel dequantizes in VMEM —
+      the s8 stream composes with the kernel's layout win.
     * ``"grouped"`` — ``[B, max_len, kv_heads, D]``: the dense
-      mixed-dot path (required for the int8 cache, and the layout
-      tensor-parallel decode shards over its head axis).
-    * ``"auto"`` — flat on TPU for bf16 causal caches with a usable
-      chunk size; grouped otherwise (CPU tests keep the dense path —
-      interpret-mode Pallas per decode step would crawl).
+      mixed-dot path (the layout tensor-parallel decode shards over
+      its head axis).
+    * ``"auto"`` — flat on TPU for causal caches with a usable chunk
+      size: always for bf16, and for int8 under MHA only (the measured
+      win region — a GQA-shrunken s8 cache's byte saving no longer
+      pays for the kernel's in-VMEM dequant, so GQA int8 keeps the
+      grouped dense path; scripts/int8_flat_decode_ab.py).  Grouped
+      otherwise (CPU tests keep the dense path — interpret-mode Pallas
+      per decode step would crawl).
 
     **Tensor-parallel decode**: when ``cfg.mesh`` carries an active tp
     axis that divides ``kv_heads``, the grouped cache is sharded over
@@ -814,11 +857,11 @@ def init_cache(cfg: TransformerConfig, batch_size: int, max_len: int,
     docs/inference.md "Serving topology" for when dp- vs tp-sharding
     wins.
 
-    ``quantized=True`` builds an int8 grouped cache (s8 K/V plus f32
-    per-(position, head) scales): half the HBM bytes per decode step,
-    quantization happens at write time inside ``Attention``.  Unwritten
-    slots are masked out of attention, so the zero scales never feed the
-    softmax."""
+    ``quantized=True`` builds an int8 cache (s8 K/V plus f32
+    per-(position, head) scales, grouped or flat): half the HBM bytes
+    per decode step, quantization happens at write time inside
+    ``Attention``.  Unwritten slots are masked out of attention, so the
+    zero scales never feed the softmax."""
     if max_len > cfg.max_seq_len:
         raise ValueError(
             f"cache max_len {max_len} exceeds max_seq_len {cfg.max_seq_len}")
@@ -834,16 +877,26 @@ def init_cache(cfg: TransformerConfig, batch_size: int, max_len: int,
         # sharded decode keeps the dense grouped path
         unsharded = cfg.mesh is None or all(
             s == 1 for s in cfg.mesh.shape.values())
-        use_flat = (not quantized and cfg.causal and unsharded
+        use_flat = (cfg.causal and unsharded
                     and jax.default_backend() == "tpu"
                     and decode_attention_usable(
                         (batch_size, 1, cfg.num_heads, D), max_len,
-                        quantized))
+                        quantized, kv_heads=KV))
         layout = "flat" if use_flat else "grouped"
     if layout == "flat":
-        if quantized:
-            raise ValueError("the int8 cache uses the grouped layout")
         shape = (batch_size, max_len, KV * D)
+        if quantized:
+            # flat int8: s8 values in the kernel's contiguous stream
+            # layout plus the per-(position, head) f32 scales — the
+            # fused decode kernel dequantizes in VMEM
+            # (ops/decode_attention.py k_scale/v_scale)
+            return tuple(
+                {"k": jnp.zeros(shape, jnp.int8),
+                 "v": jnp.zeros(shape, jnp.int8),
+                 "k_scale": jnp.zeros(shape[:2] + (KV,), jnp.float32),
+                 "v_scale": jnp.zeros(shape[:2] + (KV,), jnp.float32)}
+                for _ in range(cfg.num_layers)
+            )
         return tuple(
             {"k": jnp.zeros(shape, cfg.dtype),
              "v": jnp.zeros(shape, cfg.dtype)}

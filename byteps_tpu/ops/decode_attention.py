@@ -68,9 +68,15 @@ DEFAULT_BLOCK_S = 512
 _NEG_INF = -1e30
 
 
-def _decode_kernel(pos_ref, qblk_ref, k_ref, v_ref, o_ref,
-                   acc_ref, m_ref, l_ref, *, ns: int, bs: int, S: int,
-                   window: Optional[int]):
+def _decode_kernel(pos_ref, *refs, ns: int, bs: int, S: int,
+                   window: Optional[int], quant: bool, cdt):
+    if quant:
+        (qblk_ref, k_ref, v_ref, ks_ref, vs_ref, oh_ref, o_ref,
+         acc_ref, m_ref, l_ref) = refs
+    else:
+        (qblk_ref, k_ref, v_ref, o_ref,
+         acc_ref, m_ref, l_ref) = refs
+        ks_ref = vs_ref = oh_ref = None
     j = pl.program_id(1)
     pos = pos_ref[0]
     H = qblk_ref.shape[1]
@@ -89,9 +95,22 @@ def _decode_kernel(pos_ref, qblk_ref, k_ref, v_ref, o_ref,
     def _step():
         qb = qblk_ref[0]                       # [Hp, KV*D]
         k = k_ref[0]                           # [BS, KV*D]
+        if quant:
+            # the s8 chunk streams half the HBM bytes (the whole point);
+            # the VMEM-resident convert feeds the MXU at the compute
+            # dtype.  Dequant scale commutes out of the D-contraction
+            # (constant along D within a head's block), applied to the
+            # scores below via the onehot row->group map.
+            k = k.astype(cdt)
         s = jax.lax.dot_general(
             qb, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # [Hp, BS]
+        if quant:
+            # scale[h, j] = k_scale[j, grp[h]]: [Hp, KV] @ [BS, KV]^T
+            srow = jax.lax.dot_general(
+                oh_ref[...], ks_ref[0], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [Hp, BS]
+            s = s * srow
         kidx = j * bs + jax.lax.broadcasted_iota(jnp.int32, (H, bs), 1)
         valid = kidx <= pos
         if window is not None:
@@ -105,6 +124,20 @@ def _decode_kernel(pos_ref, qblk_ref, k_ref, v_ref, o_ref,
                                                   keepdims=True)
         m_ref[...] = m_new
         v = v_ref[0]
+        if quant:
+            # v's scale is constant along the contracted S axis's
+            # *partner* (the output D-block) but varies per (row, head):
+            # fold v_scale[j, grp[h]] into p before the PV dot — row h's
+            # output block then carries the dequantized sum, cross-head
+            # columns are garbage and discarded outside.  Mask invalid
+            # columns FIRST: a tail chunk's out-of-range scale rows are
+            # padding (arbitrary bits — NaN on hardware), and p's zero
+            # there does not survive 0 * NaN.
+            vrow = jax.lax.dot_general(
+                oh_ref[...], vs_ref[0], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [Hp, BS]
+            p = p * jnp.where(valid, vrow, 0.0)
+            v = v.astype(cdt)
         if S % bs:
             # the tail chunk's out-of-range rows are padding (NaN in
             # interpret mode, arbitrary bits on hardware); their p
@@ -125,7 +158,8 @@ def _decode_kernel(pos_ref, qblk_ref, k_ref, v_ref, o_ref,
 
 @functools.partial(jax.jit, static_argnames=("window", "block_s",
                                              "interpret"))
-def decode_attention(q, ck, cv, pos, *, window: Optional[int] = None,
+def decode_attention(q, ck, cv, pos, *, k_scale=None, v_scale=None,
+                     window: Optional[int] = None,
                      block_s: int = DEFAULT_BLOCK_S, interpret=None):
     """Fused single-step cached attention.
 
@@ -134,10 +168,20 @@ def decode_attention(q, ck, cv, pos, *, window: Optional[int] = None,
     ``pos`` are unwritten (``H % KV == 0``; GQA/MQA welcome).  Returns
     ``[B, 1, H, D]``, numerically matching
     ``models.transformer._cached_attention`` at tq=1.
+
+    ``k_scale``/``v_scale`` (``[B, S, KV]`` f32, both or neither) mark
+    an int8 cache: ``ck/cv`` are s8 with per-(position, head) symmetric
+    scales (``_quantize_kv``).  The s8 chunks stream half the HBM bytes
+    and dequantize in VMEM; the scales fold into the scores / the
+    probabilities exactly as in the dense mixed-dot path
+    (``_cached_attention_q8``), so the result matches it at tq=1.
     """
     B, tq, H, D = q.shape
     if tq != 1:
         raise ValueError(f"decode_attention is tq=1 only, got tq={tq}")
+    quant = k_scale is not None
+    if quant != (v_scale is not None):
+        raise ValueError("pass both k_scale and v_scale or neither")
     S = ck.shape[1]
     if ck.ndim == 3:
         # flat [B, S, KV*D] cache — the layout this kernel exists for.
@@ -162,6 +206,8 @@ def decode_attention(q, ck, cv, pos, *, window: Optional[int] = None,
     # f32 accumulator — cap the pair at ~8 MB of the ~16 MB VMEM.  Wide
     # models shrink the chunk instead of failing the Mosaic compile
     # (H=32 D=128 MHA: KV*D=4096 -> bs caps at 256).
+    # (conservative for the quant path too: the s8 chunk's in-kernel
+    # convert transiently holds a compute-dtype copy alongside it)
     itemsize = jnp.dtype(q.dtype).itemsize
     vmem_cap = (8 * 1024 * 1024) // (4 * KVD * itemsize)
     bs = max(8, min(block_s, S, (vmem_cap // 8) * 8))
@@ -196,14 +242,31 @@ def decode_attention(q, ck, cv, pos, *, window: Optional[int] = None,
                 jj, jnp.maximum(pos_ref[0] - window + 1, 0) // bs)
         return (b, jj, 0)
 
+    in_specs = [
+        pl.BlockSpec((1, Hp, KVD), lambda b, j, p: (b, 0, 0)),
+        pl.BlockSpec((1, bs, KVD), kv_idx),
+        pl.BlockSpec((1, bs, KVD), kv_idx),
+    ]
+    operands = [qblk, kf, vf]
+    if quant:
+        # scale chunks ride the same clamped index map as their s8
+        # cache chunks; the padded onehot maps score/probability rows
+        # to their group's scale column in-kernel
+        in_specs += [
+            pl.BlockSpec((1, bs, KV), kv_idx),
+            pl.BlockSpec((1, bs, KV), kv_idx),
+        ]
+        oh_pad = onehot.astype(jnp.float32)
+        if Hp != H:
+            oh_pad = jnp.pad(oh_pad, ((0, Hp - H), (0, 0)))
+        in_specs += [pl.BlockSpec((Hp, KV), lambda b, j, p: (0, 0))]
+        operands += [k_scale.astype(jnp.float32),
+                     v_scale.astype(jnp.float32), oh_pad]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(B, ns),
-        in_specs=[
-            pl.BlockSpec((1, Hp, KVD), lambda b, j, p: (b, 0, 0)),
-            pl.BlockSpec((1, bs, KVD), kv_idx),
-            pl.BlockSpec((1, bs, KVD), kv_idx),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, Hp, KVD), lambda b, j, p: (b, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((Hp, KVD), jnp.float32),
@@ -213,13 +276,13 @@ def decode_attention(q, ck, cv, pos, *, window: Optional[int] = None,
     )
     oacc = pl.pallas_call(
         functools.partial(_decode_kernel, ns=ns, bs=bs, S=S,
-                          window=window),
+                          window=window, quant=quant, cdt=q.dtype),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Hp, KVD), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
-    )(pos_arr, qblk, kf, vf)
+    )(pos_arr, *operands)
 
     # Row h's true output lives in its group's D-block; the cross-head
     # columns of the PV dot are discarded by a static onehot contraction.
@@ -232,14 +295,25 @@ def decode_attention(q, ck, cv, pos, *, window: Optional[int] = None,
 
 
 def decode_attention_usable(q_shape, cache_len: int,
-                            quant_cache: bool) -> bool:
-    """Static gate for the auto-switch: tq=1 and a bf16-class cache (the
-    s8 cache keeps the dense mixed-dot path).  Any cache length works —
+                            quant_cache: bool,
+                            kv_heads: Optional[int] = None) -> bool:
+    """Static gate for the auto-switch: tq=1, and for an s8 cache MHA
+    only (``kv_heads == H``).  The r5 on-chip sweep
+    (scripts/int8_flat_decode_ab.py) found the flat-s8 kernel wins
+    exactly where the cache is at its largest — MHA, KV*D=768: 0.654
+    ms/tok vs 0.714 bf16-flat and 2.570 s8-grouped at B=8/T=1024 —
+    while every GQA point loses (KV*D<=384: the GQA-shrunken cache's
+    byte saving no longer pays for the in-VMEM dequant and the
+    KV-deep scale dots; KV*D=128 measures 0.408 vs 0.312 dense).
+    GQA s8 caches keep the dense mixed-dot path; explicit
+    ``init_cache(layout="flat")`` overrides.  Any cache length works —
     the kernel grid is ceil(S/block) with the tail masked — and wide
     models shrink the chunk to fit VMEM, so the only hard limit is a
     per-head accumulator row that no longer fits (absurd KV*D)."""
     B, tq, H, D = q_shape
-    if tq != 1 or quant_cache:
+    if tq != 1:
+        return False
+    if quant_cache and (kv_heads is None or kv_heads != H):
         return False
     # f32 accumulator [Hp, KV*D] must stay a small fraction of VMEM
     Hp = -(-H // 16) * 16

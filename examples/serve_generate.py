@@ -1,0 +1,87 @@
+"""Serve KV-cache generation over a dp x tp device mesh.
+
+The serving topology story (docs/inference.md "Serving topology"): batch
+rows shard over ``dp``, attention heads / kv-heads / d_ff shard over
+``tp`` (the same Megatron layout the training path uses), and
+``init_cache`` shards the KV cache's head axis so each tp shard streams
+only its own heads per decode step.  GSPMD inserts the o-proj and
+down-proj psums from the kernel partition annotations — no hand-written
+collectives.
+
+On a multi-chip host this runs as-is; on a 1-chip or CPU host pass
+``--fake-devices 8`` to demonstrate the sharding on a virtual CPU mesh
+(the same mechanism the test suite and the driver dryrun use).
+
+    python examples/serve_generate.py --fake-devices 8 --dp 4 --tp 2
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--dp", type=int, default=2)
+    p.add_argument("--tp", type=int, default=2)
+    p.add_argument("--fake-devices", type=int, default=0,
+                   help="fake N CPU devices (for 1-chip/CPU hosts)")
+    p.add_argument("--num-kv-heads", type=int, default=2,
+                   help="GQA kv heads; must be divisible by --tp for a "
+                        "sharded cache (else it replicates)")
+    p.add_argument("--max-new-tokens", type=int, default=16)
+    p.add_argument("--kv-quant", action="store_true",
+                   help="int8 KV cache (composes with tp)")
+    args = p.parse_args()
+
+    if args.fake_devices:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.fake_devices)
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from byteps_tpu.inference import generate
+    from byteps_tpu.models import Transformer, TransformerConfig
+
+    n = args.dp * args.tp
+    devices = jax.devices()
+    if len(devices) < n:
+        raise SystemExit(
+            f"need {n} devices for dp={args.dp} x tp={args.tp}, have "
+            f"{len(devices)} — pass --fake-devices {n} on small hosts")
+    mesh = Mesh(np.array(devices[:n]).reshape(args.dp, args.tp),
+                ("dp", "tp"))
+
+    cfg = TransformerConfig(
+        vocab_size=256, num_layers=2, num_heads=4,
+        num_kv_heads=args.num_kv_heads, d_model=64, d_ff=128,
+        max_seq_len=64, dtype=jnp.float32, pos_emb="rope", mlp="swiglu",
+        mesh=mesh)
+    model = Transformer(cfg)
+
+    B, T = args.dp * 2, 8
+    prompt = jax.random.randint(jax.random.PRNGKey(0), (B, T), 0, 256)
+    boxed = model.init(jax.random.PRNGKey(1), prompt)
+    specs = nn.get_partition_spec(boxed)["params"]
+    params = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        nn.meta.unbox(boxed["params"]), specs)
+    prompt = jax.device_put(prompt, NamedSharding(mesh, P("dp", None)))
+
+    out = generate(model, {"params": params}, prompt,
+                   args.max_new_tokens, temperature=0,
+                   kv_quant=args.kv_quant)
+    toks = np.asarray(out["tokens"])
+    qk = params["block_0"]["attn"]["q"]["kernel"]
+    print(f"mesh dp={args.dp} x tp={args.tp}; q kernel sharding "
+          f"{qk.sharding.spec}; generated {toks.shape} tokens")
+    print("row 0:", toks[0].tolist())
+
+
+if __name__ == "__main__":
+    main()

@@ -80,7 +80,92 @@ def test_traced_pos_one_program():
 def test_usable_gate():
     assert decode_attention_usable((8, 1, 12, 64), 1280, False)
     assert not decode_attention_usable((8, 4, 12, 64), 1280, False)
+    # s8 auto: MHA only (the measured win region — GQA's shrunken cache
+    # no longer pays for the in-VMEM dequant, scripts/int8_flat_decode_ab)
+    assert decode_attention_usable((8, 1, 12, 64), 1280, True,
+                                   kv_heads=12)
+    assert not decode_attention_usable((8, 1, 12, 64), 1280, True,
+                                       kv_heads=2)
     assert not decode_attention_usable((8, 1, 12, 64), 1280, True)
     # awkward cache lengths are fine: the grid is ceil(S/block) with the
     # tail masked
     assert decode_attention_usable((8, 1, 12, 64), 1021, False)
+
+
+@pytest.mark.parametrize("H,KV", [(4, 4), (8, 2), (4, 1)])
+@pytest.mark.parametrize("pos", [0, 33, 200])
+def test_int8_matches_grouped_q8_path(H, KV, pos):
+    """The flat-int8 kernel (s8 stream + in-VMEM dequant, scales folded
+    into scores/probabilities) must match the dense grouped mixed-dot
+    path on the SAME quantized values."""
+    from byteps_tpu.models.transformer import (
+        _cached_attention_q8,
+        _quantize_kv,
+    )
+
+    B, S, D = 2, 256, 16
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(ks[0], (B, 1, H, D), jnp.float32)
+    kfull = jax.random.normal(ks[1], (B, S, KV, D), jnp.float32)
+    vfull = jax.random.normal(ks[2], (B, S, KV, D), jnp.float32)
+    kq, kscale = _quantize_kv(kfull)
+    vq, vscale = _quantize_kv(vfull)
+    want = _cached_attention_q8(q, kq, kscale, vq, vscale, pos)
+    got = decode_attention(
+        q, kq.reshape(B, S, KV * D), vq.reshape(B, S, KV * D), pos,
+        k_scale=kscale, v_scale=vscale, block_s=64, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_flat_int8_generate_matches_grouped_int8():
+    """End to end: generate() on a flat int8 cache (layout='flat',
+    kv_quant) produces the same tokens as the grouped int8 cache — the
+    write-time quantization is identical, only the decode data path
+    differs."""
+    from byteps_tpu.inference import make_generate_fn
+    from byteps_tpu.models.transformer import Transformer, TransformerConfig
+
+    cfg = TransformerConfig(
+        vocab_size=61, num_layers=2, num_heads=4, num_kv_heads=2,
+        d_model=32, d_ff=64, max_seq_len=64, dtype=jnp.float32,
+        pos_emb="rope")
+    model = Transformer(cfg)
+    prompt = jax.random.randint(jax.random.PRNGKey(0), (2, 9), 0, 61)
+    variables = model.init(jax.random.PRNGKey(1), prompt)
+    grouped = make_generate_fn(model, 8, temperature=0, kv_quant=True,
+                               cache_layout="grouped")(
+        variables, prompt, jax.random.PRNGKey(0))
+    flat = make_generate_fn(model, 8, temperature=0, kv_quant=True,
+                            cache_layout="flat")(
+        variables, prompt, jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(np.asarray(grouped["tokens"]),
+                                  np.asarray(flat["tokens"]))
+
+
+@pytest.mark.parametrize("pos", [100, 150])
+def test_int8_tail_chunk_padding(pos):
+    """Regression: a cache length that does NOT divide the chunk makes
+    the last chunk's out-of-range SCALE rows padding (NaN in interpret
+    mode, arbitrary bits on hardware); p's zero columns do not survive
+    0 * NaN, so the kernel must mask the scale rows before folding them
+    into p.  (Caught on hardware as 'real' divergence at B=8/S=576.)"""
+    from byteps_tpu.models.transformer import (
+        _cached_attention_q8,
+        _quantize_kv,
+    )
+
+    B, S, H, KV, D = 2, 160, 4, 4, 16   # S=160, block 64 -> tail of 32
+    ks = jax.random.split(jax.random.PRNGKey(9), 3)
+    q = jax.random.normal(ks[0], (B, 1, H, D), jnp.float32)
+    kfull = jax.random.normal(ks[1], (B, S, KV, D), jnp.float32)
+    vfull = jax.random.normal(ks[2], (B, S, KV, D), jnp.float32)
+    kq, kscale = _quantize_kv(kfull)
+    vq, vscale = _quantize_kv(vfull)
+    want = _cached_attention_q8(q, kq, kscale, vq, vscale, pos)
+    got = decode_attention(
+        q, kq.reshape(B, S, KV * D), vq.reshape(B, S, KV * D), pos,
+        k_scale=kscale, v_scale=vscale, block_s=64, interpret=True)
+    assert np.isfinite(np.asarray(got, np.float32)).all()
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-3, atol=2e-3)

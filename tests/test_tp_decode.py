@@ -136,3 +136,39 @@ def test_tp_int8_weights_generate_runs():
     ref_q = {"params": quantize_params(ref_vars["params"])}
     want = _reference_tokens(cfg, ref_q, prompt, 5)
     np.testing.assert_array_equal(got, want)
+
+
+def test_tp_speculative_matches_single_device():
+    """Speculative decoding under the tp serving mesh: both models'
+    grouped caches shard their head axes, the verify block's tq>1
+    dense path runs per shard, and the output still equals plain
+    greedy decode (the speculative contract is placement-independent)."""
+    from byteps_tpu.inference import speculative_generate, truncated_draft
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    cfg, model = _build(mesh)
+    prompt = jax.random.randint(jax.random.PRNGKey(5), (2, 8), 0, 61)
+    tp_vars, ref_vars = _sharded_params(cfg, model, mesh, prompt)
+    dmodel, dvars = truncated_draft(cfg, tp_vars, 1)
+    out = speculative_generate(model, tp_vars, dmodel, dvars, prompt, 8,
+                               gamma=3)
+    want = _reference_tokens(cfg, ref_vars, prompt, 8)
+    np.testing.assert_array_equal(np.asarray(out["tokens"]), want)
+
+
+def test_tp_beam_search_matches_single_device():
+    """Beam search under tp: the in-scan cache reorder (batched take on
+    the beam-tiled batch axis) composes with the head-sharded cache."""
+    from byteps_tpu.inference import beam_search
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+    cfg, model = _build(mesh)
+    prompt = jax.random.randint(jax.random.PRNGKey(6), (2, 7), 0, 61)
+    tp_vars, ref_vars = _sharded_params(cfg, model, mesh, prompt)
+    got = beam_search(model, tp_vars, prompt, 6, num_beams=3)
+    ref_model = Transformer(dataclasses.replace(cfg, mesh=None))
+    want = beam_search(ref_model, ref_vars, prompt, 6, num_beams=3)
+    np.testing.assert_array_equal(np.asarray(got["tokens"]),
+                                  np.asarray(want["tokens"]))
+    np.testing.assert_allclose(np.asarray(got["scores"]),
+                               np.asarray(want["scores"]), rtol=1e-4)
