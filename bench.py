@@ -934,34 +934,42 @@ def main():
     # default a user gets), bf16 grouped (the same dense mixed-dot path
     # the int8 cache runs, so the ratio vs it is pure byte-halving),
     # and int8 grouped.
+    def _kv_cache_arms(cfg, B, T, arm_list, seed):
+        """Init a bf16 tree for ``cfg`` and time each decode arm at
+        (B, T) with pinned cache geometry; returns ({name: (ms,
+        method)}, non-embedding param count) — the shared core of the
+        int8-KV rows below."""
+        m = _Tfm(cfg)
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(seed), (B, T), 0, cfg.vocab_size)
+        vtree = jax.tree_util.tree_map(
+            lambda x: x.astype(cfg.dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x,
+            m.init(jax.random.PRNGKey(12), prompt[:1]))
+        CLa = T + nL
+        res = {}
+        for aname, akw in arm_list:
+            a_s = make_generate_fn(m, nS, temperature=0,
+                                   cache_len=CLa, **akw)
+            a_l = make_generate_fn(m, nL, temperature=0,
+                                   cache_len=CLa, **akw)
+            res[aname] = _median_diff_ms(
+                a_s, a_l, (vtree, prompt, grng), nL - nS, cache_len=CLa)
+        return res, _nonembed_params(vtree["params"])
+
     if on_tpu:
         lcT = 2048
         lcB = 32
         kv_cfg = dataclasses.replace(
             gcfg, num_kv_heads=2, attn_impl="flash",
             max_seq_len=lcT + nL + 8)
-        kv_model = _Tfm(kv_cfg)
-        kv_prompt = jax.random.randint(
-            jax.random.PRNGKey(21), (lcB, lcT), 0, kv_cfg.vocab_size)
-        kv_vars = jax.tree_util.tree_map(
-            lambda x: x.astype(kv_cfg.dtype)
-            if jnp.issubdtype(x.dtype, jnp.floating) else x,
-            kv_model.init(jax.random.PRNGKey(12), kv_prompt[:1]))
         kv_CL = lcT + nL
-        arms = {}
-        for aname, akw in (
-                ("bf16_auto", {}),
-                ("bf16_grouped", {"cache_layout": "grouped"}),
-                ("int8", {"kv_quant": True})):
-            a_s = make_generate_fn(kv_model, nS, temperature=0,
-                                   cache_len=kv_CL, **akw)
-            a_l = make_generate_fn(kv_model, nL, temperature=0,
-                                   cache_len=kv_CL, **akw)
-            arms[aname] = _median_diff_ms(
-                a_s, a_l, (kv_vars, kv_prompt, grng), nL - nS,
-                cache_len=kv_CL)
+        arms, kv_np = _kv_cache_arms(
+            kv_cfg, lcB, lcT,
+            (("bf16_auto", {}),
+             ("bf16_grouped", {"cache_layout": "grouped"}),
+             ("int8", {"kv_quant": True})), seed=21)
         ms_kv, m_kv = arms["int8"]
-        kv_np = _nonembed_params(kv_vars["params"])
         res = _decode_row(
             f"generate_decode_int8kv_B{lcB}_T{lcT}_tokens_per_sec"
             f"{suffix}", (ms_kv, m_kv), lcB, {
@@ -987,7 +995,36 @@ def main():
             }, n_par=kv_np)
         results.append(res)
         print(json.dumps(res), flush=True)
-        del kv_vars, arms
+        del arms
+
+        # --- flat-int8 fused decode kernel, MHA (r5) ------------------
+        # MHA is where the int8 cache and the fused kernel compose
+        # (scripts/int8_flat_decode_ab.py: every GQA point loses — the
+        # GQA-shrunken cache's byte saving no longer pays for the
+        # in-VMEM dequant).  kv_quant on an MHA config auto-selects the
+        # flat-s8 kernel; vs_baseline is the bf16 flat kernel at the
+        # same geometry — the best-vs-best MHA comparison.
+        mhaB, mhaT = 8, 1024
+        mha_cfg = dataclasses.replace(gcfg, attn_impl="flash",
+                                      max_seq_len=mhaT + nL + 8)
+        mha_arms, mha_np = _kv_cache_arms(
+            mha_cfg, mhaB, mhaT,
+            (("bf16", {}), ("int8kv", {"kv_quant": True})), seed=22)
+        ms_mha, m_mha = mha_arms["int8kv"]
+        res = _decode_row(
+            f"generate_decode_int8kv_mha_B{mhaB}_T{mhaT}_tokens_per_sec"
+            f"{suffix}", (ms_mha, m_mha), mhaB, {
+                **_xrow_ratio(mha_arms["bf16"][0], mha_arms["bf16"][1],
+                              ms_mha, m_mha),
+                "vs_baseline_meaning": (
+                    "MHA int8-KV through the fused flat-s8 decode "
+                    "kernel (auto-selected) vs the bf16 flat kernel at "
+                    "the same geometry — best-vs-best"),
+                "ms_per_token_bf16_flat": round(mha_arms["bf16"][0], 3),
+            }, n_par=mha_np)
+        results.append(res)
+        print(json.dumps(res), flush=True)
+        del mha_arms
 
     # --- speculative decoding: two self-draft variants ----------------
     # Speculative speedup = f(draft cost, acceptance); without a TRAINED
@@ -1259,7 +1296,11 @@ def _certification(results, headline):
                 "vs_baseline"),
             "spec_trained_vs_plain": _find(
                 "speculative_layerskip_trained").get("vs_baseline"),
-            "int8kv_b32_vs_bf16": _find("int8kv").get("vs_baseline"),
+            # "int8kv_B" matches the B{lcB} row at any future geometry
+            # while staying distinct from the int8kv_mha row
+            "int8kv_b32_vs_bf16": _find("int8kv_B").get("vs_baseline"),
+            "int8kv_mha_ms_tok": _find("int8kv_mha").get(
+                "ms_per_token_decode"),
         },
     }
 

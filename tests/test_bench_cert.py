@@ -39,6 +39,9 @@ def _rows():
          "value": 4200.0, "unit": "tokens/sec", "vs_baseline": 1.2},
         {"metric": "generate_decode_int8kv_B32_T2048_tokens_per_sec",
          "value": 33600.0, "unit": "tokens/sec", "vs_baseline": 1.54},
+        {"metric": "generate_decode_int8kv_mha_B8_T1024_tokens_per_sec",
+         "value": 12230.0, "unit": "tokens/sec", "vs_baseline": 1.09,
+         "ms_per_token_decode": 0.654},
         {"metric": "speculative_layerskip_trained_B1_T256_tokens_per_sec",
          "value": 7100.0, "unit": "tokens/sec", "vs_baseline": 1.98},
     ]
@@ -60,6 +63,7 @@ def test_certification_line():
     assert kn["decode_gqa_ms_tok"] == 0.27
     assert kn["decode_b1_int8_vs_bf16"] == 1.2
     assert kn["int8kv_b32_vs_bf16"] == 1.54
+    assert kn["int8kv_mha_ms_tok"] == 0.654
     assert kn["spec_trained_vs_plain"] == 1.98
     # must survive the driver's ~2000-char tail capture
     assert len(json.dumps(cert)) < 1900
