@@ -169,3 +169,29 @@ def test_int8_tail_chunk_padding(pos):
     assert np.isfinite(np.asarray(got, np.float32)).all()
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("pos", [60, 150])
+def test_int8_window_matches_grouped_q8(pos):
+    """Sliding-window attention through the quant kernel: the window
+    band mask composes with the scale folding (both sides of the valid
+    mask) and matches the dense mixed-dot path."""
+    from byteps_tpu.models.transformer import (
+        _cached_attention_q8,
+        _quantize_kv,
+    )
+
+    B, S, H, KV, D, W = 1, 192, 4, 2, 16, 48
+    ks = jax.random.split(jax.random.PRNGKey(11), 3)
+    q = jax.random.normal(ks[0], (B, 1, H, D), jnp.float32)
+    kfull = jax.random.normal(ks[1], (B, S, KV, D), jnp.float32)
+    vfull = jax.random.normal(ks[2], (B, S, KV, D), jnp.float32)
+    kq, kscale = _quantize_kv(kfull)
+    vq, vscale = _quantize_kv(vfull)
+    want = _cached_attention_q8(q, kq, kscale, vq, vscale, pos, window=W)
+    got = decode_attention(
+        q, kq.reshape(B, S, KV * D), vq.reshape(B, S, KV * D), pos,
+        k_scale=kscale, v_scale=vscale, window=W, block_s=64,
+        interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-3, atol=2e-3)
