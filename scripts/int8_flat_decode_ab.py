@@ -16,14 +16,17 @@ r5 result on the bench chip (TPU v5 lite), ms/token:
 
     B=32 T=2048 GQA kv=2:  bf16_flat 1.462  s8_grouped 0.950  s8_flat 2.067
     B=8  T=1024 MHA:       bf16_flat 0.714  s8_grouped 2.570  s8_flat 0.654
+    B=32 T=2048 MHA:       bf16_flat 4.082  s8_grouped 6.797  s8_flat 3.646
     B=8  T=1024 kv=6:      bf16_flat 0.452  s8_grouped 0.586  s8_flat 0.512
     B=8  T=1024 kv=4:      bf16_flat 0.377  s8_grouped 0.460  s8_flat 0.454
     B=8  T=1024 kv=2:      bf16_flat 0.312  s8_grouped 0.312  s8_flat 0.408
 
 CONCLUSION — the flat-s8 kernel wins exactly where the cache is at its
 largest: **MHA** (KV*D=768), where it is the best decode path on record
-(1.09x over bf16-flat, 3.9x over the s8 dense path, which collapses at
-MHA).  Every GQA point loses: GQA already shrank the cache, so halving
+at BOTH geometries (B=8: 1.09x over bf16-flat, 3.9x over the s8 dense
+path, which collapses at MHA; cache-dominated B=32/T=2048, ~2.7 GB
+bf16 cache: 1.12x / 1.86x — the in-kernel s8->bf16 convert scales with
+the same bytes it saves, which caps the byte-halving's realized win).  Every GQA point loses: GQA already shrank the cache, so halving
 its bytes saves less than the kernel's in-VMEM s8->bf16 convert and the
 KV-deep scale-row dots cost; at B=32/T=2048 kv=2 the s8 stream is also
 better served by XLA's one batched mixed dot (s8_grouped 0.950 is the
