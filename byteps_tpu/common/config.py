@@ -48,6 +48,21 @@ def _env_str(name: str, default: str) -> str:
     return os.environ.get(name, default)
 
 
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    return float(v)
+
+
+def _env_opt_bool(name: str) -> Optional[bool]:
+    """Tri-state: unset/"" -> None (auto), else truthiness like _env_bool."""
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return None
+    return v.lower() not in ("0", "false", "no", "off", "")
+
+
 @dataclasses.dataclass
 class Config:
     """Snapshot of all byteps_tpu knobs.
@@ -94,6 +109,22 @@ class Config:
     server_profile_output_path: str = "server_profile.json"
     server_key_to_profile: Optional[int] = None  # None = all keys
 
+    # --- resilience (byteps_tpu addition, no reference counterpart —
+    # ps-lite had no recovery story; see docs/resilience.md) ---------------
+    retry_max_attempts: int = 3       # total tries per op; 1 = fail fast
+    retry_backoff_ms: float = 50.0    # sleep before 2nd attempt
+    retry_backoff_mult: float = 2.0   # exponential growth per attempt
+    retry_jitter: float = 0.1         # +-10% randomization of each sleep
+    retry_deadline_ms: float = 15_000.0  # per-op wall bound; 0 = none
+    # None = auto (guard on when DMLC_NUM_WORKER <= 1): the OP_VERSION
+    # dedup of retried mutations is only unambiguous for a single writer
+    # per key — see docs/resilience.md "Exactly-once retried mutations"
+    retry_version_guard: Optional[bool] = None
+    heartbeat_interval_ms: float = 0.0   # 0 = no heartbeat thread
+    heartbeat_timeout_ms: float = 1_000.0  # per-ping connect/read bound
+    heartbeat_miss_threshold: int = 3  # consecutive misses -> shard DOWN
+    failover: bool = True  # degraded-mode re-routing around dead shards
+
     # --- TPU-specific ----------------------------------------------------
     wire_dtype: str = ""  # "" (no compression) | "bf16" | "fp16"
     mesh_shape: str = ""  # e.g. "dp=8" or "dcn=2,dp=4"; "" = auto
@@ -119,6 +150,17 @@ class Config:
             server_profile_output_path=_env_str(
                 "BYTEPS_SERVER_PROFILE_OUTPUT_PATH", "server_profile.json"),
             server_key_to_profile=_env_opt_int("BYTEPS_SERVER_KEY_TO_PROFILE"),
+            retry_max_attempts=_env_int("BYTEPS_RETRY_MAX_ATTEMPTS", 3),
+            retry_backoff_ms=_env_float("BYTEPS_RETRY_BACKOFF_MS", 50.0),
+            retry_backoff_mult=_env_float("BYTEPS_RETRY_BACKOFF_MULT", 2.0),
+            retry_jitter=_env_float("BYTEPS_RETRY_JITTER", 0.1),
+            retry_deadline_ms=_env_float("BYTEPS_RETRY_DEADLINE_MS", 15_000.0),
+            retry_version_guard=_env_opt_bool("BYTEPS_RETRY_VERSION_GUARD"),
+            heartbeat_interval_ms=_env_float("BYTEPS_HEARTBEAT_INTERVAL_MS", 0.0),
+            heartbeat_timeout_ms=_env_float("BYTEPS_HEARTBEAT_TIMEOUT_MS", 1_000.0),
+            heartbeat_miss_threshold=_env_int(
+                "BYTEPS_HEARTBEAT_MISS_THRESHOLD", 3),
+            failover=_env_bool("BYTEPS_FAILOVER", True),
             wire_dtype=_env_str("BYTEPS_WIRE_DTYPE", ""),
             mesh_shape=_env_str("BYTEPS_MESH_SHAPE", ""),
         )

@@ -157,3 +157,17 @@ class ServerSharder:
     def load(self) -> List[int]:
         with self._lock:
             return list(self._bytes)
+
+    @staticmethod
+    def remap(shard: int, exclude, num_shards: int) -> int:
+        """Deterministic degraded-mode remap: the first alive shard
+        scanning forward from ``shard`` (wrapping).  Every worker
+        computes the same fallback with no coordination — the same
+        property the placement formula itself has — so two clients
+        re-route a dead shard's keys identically.  Raises when every
+        shard is excluded."""
+        for step in range(num_shards):
+            candidate = (shard + step) % num_shards
+            if candidate not in exclude:
+                return candidate
+        raise RuntimeError("all PS shards are marked down")

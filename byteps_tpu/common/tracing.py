@@ -74,6 +74,26 @@ class Tracer:
                     }
                 )
 
+    def counter(self, name: str, value: float, stage: str = "counters") -> None:
+        """Chrome-trace counter event ("ph": "C") — renders as a value
+        track in chrome://tracing / Perfetto.  Used by the resilience
+        subsystem to put retries/failovers/heartbeat misses on the same
+        timeline as the push/pull spans."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._events.append(
+                {
+                    "name": name,
+                    "cat": stage,
+                    "ph": "C",
+                    "ts": self._now_us(),
+                    "pid": os.getpid(),
+                    "tid": stage,
+                    "args": {"value": value},
+                }
+            )
+
     def instant(self, name: str, stage: str, **args) -> None:
         if not self.enabled:
             return

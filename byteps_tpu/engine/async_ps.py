@@ -66,14 +66,47 @@ class AsyncParameterServer:
 
     # -------------------------------------------------------------- tensors
 
-    def init_tensor(self, name: str, value: np.ndarray) -> None:
+    def init_tensor(self, name: str, value: np.ndarray) -> int:
         """First-push-wins initialization (reference InitTensor's blocking
-        initial push, operations.cc:262-284)."""
+        initial push, operations.cc:262-284).  Returns the tensor's
+        version (0 when this call created it; the existing counter when
+        it already lived here — the PS wire tier forwards this to
+        clients for retry idempotence)."""
+        return self.init_tensor_info(name, value)[0]
+
+    def init_tensor_info(self, name: str, value: np.ndarray):
+        """(version, created) — the wire tier needs ``created`` because
+        version 0 alone cannot distinguish "this call created the
+        tensor" from "existed, never pushed" (a first-push-wins loser
+        must be told the winning value; the creator must not pay a
+        pointless echo of its own seed)."""
+        with self._global_lock:
+            created = name not in self._store
+            if created:
+                self._store[name] = np.array(value, copy=True)
+                self._locks[name] = threading.Lock()
+                self._version[name] = 0
+            return self._version[name], created
+
+    def set_tensor(self, name: str, value: np.ndarray) -> int:
+        """Force-overwrite — the resilience layer's failover/failback
+        re-seed (engine/ps_server.py OP_SET).  Unlike ``init_tensor``'s
+        first-push-wins, this replaces a value the store already holds
+        (a stale leftover from an earlier failover episode, or state
+        that survived a network partition, must never shadow the
+        authoritative seed).  Creates the tensor when absent (version
+        0); otherwise advances the version with the overwrite."""
         with self._global_lock:
             if name not in self._store:
                 self._store[name] = np.array(value, copy=True)
                 self._locks[name] = threading.Lock()
                 self._version[name] = 0
+                return 0
+            lock = self._locks[name]
+        with lock:
+            self._store[name] = np.array(value, copy=True)
+            self._version[name] += 1
+            return self._version[name]
 
     def _accumulate(self, dst: np.ndarray, delta: np.ndarray) -> None:
         if self._reducer is not None:
@@ -83,10 +116,13 @@ class AsyncParameterServer:
         else:
             dst += delta
 
-    def push_delta(self, name: str, delta: np.ndarray) -> None:
+    def push_delta(self, name: str, delta: np.ndarray) -> int:
+        """Add a delta; returns the post-push version (atomic with the
+        add — the wire tier's idempotence guard needs the two paired)."""
         with self._locks[name]:
             self._accumulate(self._store[name], np.asarray(delta, self._store[name].dtype))
             self._version[name] += 1
+            return self._version[name]
 
     def pull(self, name: str) -> np.ndarray:
         with self._locks[name]:
@@ -95,10 +131,17 @@ class AsyncParameterServer:
     def push_pull(self, name: str, delta: np.ndarray) -> np.ndarray:
         """Atomic add-then-read (what the reference's paired ZPush/ZPull pair
         achieves per key, core_loops.cc:430-502)."""
+        return self.push_pull_versioned(name, delta)[0]
+
+    def push_pull_versioned(self, name: str, delta: np.ndarray):
+        """(global value, post-op version) under ONE lock acquisition —
+        the wire tier must pair the two atomically or a concurrent
+        mutation's version gets attributed to this op, corrupting the
+        client's retry-dedup baseline."""
         with self._locks[name]:
             self._accumulate(self._store[name], np.asarray(delta, self._store[name].dtype))
             self._version[name] += 1
-            return self._store[name].copy()
+            return self._store[name].copy(), self._version[name]
 
     def version(self, name: str) -> int:
         with self._locks[name]:
@@ -136,12 +179,22 @@ class ShardedParameterStore:
 
         return self._sharder.place(name_key(name), nbytes)
 
-    def init_tensor(self, name: str, value: np.ndarray) -> None:
-        self._shards[self.shard_of(name)].init_tensor(name, value)
+    def init_tensor(self, name: str, value: np.ndarray) -> int:
+        return self._shards[self.shard_of(name)].init_tensor(name, value)
 
-    def push_delta(self, name: str, delta: np.ndarray) -> None:
+    def set_tensor(self, name: str, value: np.ndarray) -> int:
+        return self._shards[self.shard_of(name)].set_tensor(name, value)
+
+    def init_tensor_info(self, name: str, value: np.ndarray):
+        return self._shards[self.shard_of(name)].init_tensor_info(name, value)
+
+    def push_pull_versioned(self, name: str, delta: np.ndarray):
         d = np.asarray(delta)
-        self._shards[self.shard_of(name, d.nbytes)].push_delta(name, d)
+        return self._shards[self.shard_of(name, d.nbytes)].push_pull_versioned(name, d)
+
+    def push_delta(self, name: str, delta: np.ndarray) -> int:
+        d = np.asarray(delta)
+        return self._shards[self.shard_of(name, d.nbytes)].push_delta(name, d)
 
     def pull(self, name: str) -> np.ndarray:
         return self._shards[self.shard_of(name)].pull(name)

@@ -215,6 +215,10 @@ class Engine:
                 self._completion_q.put(task)
             except Exception as e:  # pragma: no cover
                 bps_log.error("dispatch failed for %s: %s", task.name, e)
+                from ..resilience import counters as _cn
+
+                _cn.get_counters().bump(_cn.DISPATCH_FAILURE,
+                                        name=task.name, key=task.key)
                 req: _PushPullRequest = task.request  # type: ignore[attr-defined]
                 status = Status.UnknownError(str(e))
                 if req.mark_failed():
@@ -257,6 +261,10 @@ class Engine:
                 status = Status.OK()
             except Exception as e:  # pragma: no cover
                 status = Status.UnknownError(str(e))
+                from ..resilience import counters as _cn
+
+                _cn.get_counters().bump(_cn.TASK_FAILURE,
+                                        name=task.name, key=task.key)
             self.queue.report_finish(task)
             sample = get_config().debug_sample_tensor
             if sample and sample in task.name:

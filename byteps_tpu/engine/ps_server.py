@@ -29,10 +29,21 @@ Wire protocol (binary, length-prefixed; one request per round-trip):
 
 Ops: 0=INIT (first-push-wins), 1=PUSH_PULL (atomic add+read),
 2=PULL, 3=VERSION (payload = u64), 4=NAMES (payload = '\n'.join),
-5=PING, 6=PUSH (delta add, status-only reply — no tensor download).
+5=PING, 6=PUSH (delta add, status-only reply — no tensor download),
+7=SET (force-overwrite — the failover/failback re-seed op: unlike
+INIT's first-push-wins it replaces a tensor a shard already holds, so
+a stale leftover copy can never shadow the authoritative state).
 No pickling — payloads are raw ``numpy`` buffers, like ps-lite's zero-copy
 char views.  Store-level errors come back as status=1 replies with the
 message in the payload; the connection survives.
+
+Replies to the versioned mutations (INIT, SET, PUSH, PUSH_PULL) carry the
+post-op version counter as a decimal string in the otherwise-unused
+reply ``name`` field.  ``RemoteStore`` records it per tensor so that a
+retried mutation whose first reply was lost mid-connection can ask
+``OP_VERSION`` whether the server already applied it (exactly-once under
+connection resets for a single writer per key — see
+resilience/policy.py and docs/resilience.md).
 """
 
 from __future__ import annotations
@@ -50,7 +61,8 @@ from ..common import logging as bps_log
 from ..common.context import name_key
 from .async_ps import AsyncParameterServer
 
-OP_INIT, OP_PUSH_PULL, OP_PULL, OP_VERSION, OP_NAMES, OP_PING, OP_PUSH = range(7)
+(OP_INIT, OP_PUSH_PULL, OP_PULL, OP_VERSION, OP_NAMES, OP_PING, OP_PUSH,
+ OP_SET) = range(8)
 _MAX_NAME = 1 << 16
 _MAX_PAYLOAD = 1 << 34  # 16 GiB sanity bound
 
@@ -81,6 +93,21 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
             raise ConnectionError("peer closed mid-message")
         buf += chunk
     return bytes(buf)
+
+
+def hard_reset(sock: socket.socket) -> None:
+    """Close with an RST (SO_LINGER 0), not a FIN — the peer sees
+    ECONNRESET mid-RPC, the way a crashed process looks.  Shared by
+    ``PSServer.kill`` and the chaos proxy."""
+    try:
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                        struct.pack("ii", 1, 0))
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
 
 
 def _encode(op: int, name: str, arr: Optional[np.ndarray],
@@ -173,14 +200,27 @@ class ServerProfiler:
         e = {"name": ev, "ph": "E", "pid": key, "tid": key,
              "ts": int((self._epoch + t_end) * 1e6)}
         drained = None
+        dropped = False
         with self._lock:
-            self._events.append(b)
-            self._events.append(e)
-            if len(self._events) >= self._AUTOFLUSH:
-                # swap the buffer out under the lock, write OUTSIDE it —
-                # the request that trips the threshold must not stall
-                # every concurrent handler behind file I/O
-                drained, self._events = self._events, []
+            if self._closed:
+                # a record() after close() would buffer events nothing
+                # will ever drain (the file's array is already
+                # terminated) — drop them as loudly as _write() drops a
+                # batch that raced close()
+                dropped = True
+            else:
+                self._events.append(b)
+                self._events.append(e)
+                if len(self._events) >= self._AUTOFLUSH:
+                    # swap the buffer out under the lock, write OUTSIDE
+                    # it — the request that trips the threshold must not
+                    # stall every concurrent handler behind file I/O
+                    drained, self._events = self._events, []
+        if dropped:
+            bps_log.debug(
+                "ps_server profiler: dropping 2 events recorded after "
+                "close()")
+            return
         if drained:
             self._write(drained)
 
@@ -254,6 +294,7 @@ class _Handler(socketserver.BaseRequestHandler):
         peer = "%s:%s" % self.client_address[:2]
         sock = self.request
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.server.track_connection(sock)  # type: ignore[attr-defined]
         try:
             while True:
                 try:
@@ -266,13 +307,42 @@ class _Handler(socketserver.BaseRequestHandler):
                 # failures tear it down
                 try:
                     if op == OP_INIT:
-                        store.init_tensor(name, arr)
-                        reply = _encode(0, "", None)
+                        # a first-push-wins LOSER gets the winning value
+                        # in the reply (clients seed failover state from
+                        # it); the creator gets a bare ack — its own seed
+                        # IS the value, echoing the tensor back would be
+                        # a pointless full-model transfer at startup
+                        info = getattr(store, "init_tensor_info", None)
+                        if info is not None:
+                            v, created = info(name, arr)
+                        else:  # duck-typed store: echo to be safe
+                            v = store.init_tensor(name, arr)
+                            if v is None:
+                                v = store.version(name)
+                            created = False
+                        reply = _encode(0, str(v),
+                                        None if created else store.pull(name))
                     elif op == OP_PUSH_PULL:
-                        reply = _encode(0, "", store.push_pull(name, arr))
+                        # version must be read under the same lock as the
+                        # add, or a concurrent mutation's counter gets
+                        # attributed to this op (dedup-baseline poison)
+                        pv = getattr(store, "push_pull_versioned", None)
+                        if pv is not None:
+                            out, v = pv(name, arr)
+                        else:
+                            out = store.push_pull(name, arr)
+                            v = store.version(name)
+                        reply = _encode(0, str(v), out)
                     elif op == OP_PUSH:
-                        store.push_delta(name, arr)
-                        reply = _encode(0, "", None)
+                        v = store.push_delta(name, arr)
+                        if v is None:
+                            v = store.version(name)
+                        reply = _encode(0, str(v), None)
+                    elif op == OP_SET:
+                        v = store.set_tensor(name, arr)
+                        if v is None:
+                            v = store.version(name)
+                        reply = _encode(0, str(v), None)
                     elif op == OP_PULL:
                         reply = _encode(0, "", store.pull(name))
                     elif op == OP_VERSION:
@@ -295,6 +365,8 @@ class _Handler(socketserver.BaseRequestHandler):
                 sock.sendall(reply)
         except Exception as e:  # pragma: no cover - connection teardown races
             bps_log.debug("ps_server handler exit: %s", e)
+        finally:
+            self.server.untrack_connection(sock)  # type: ignore[attr-defined]
 
 
 class PSServer(socketserver.ThreadingTCPServer):
@@ -303,16 +375,50 @@ class PSServer(socketserver.ThreadingTCPServer):
 
     def __init__(self, addr, use_native: bool = True):
         super().__init__(addr, _Handler)
-        self.store = AsyncParameterServer(use_native=use_native)
-        from ..common.config import get_config
+        # anything failing after the super() bind must release the
+        # listening socket, or a supervised restart (launcher
+        # BYTEPS_SERVER_MAX_RESTARTS) hits EADDRINUSE on the same port
+        # for the rest of its budget
+        try:
+            self.profiler: Optional[ServerProfiler] = None
+            self.store = AsyncParameterServer(use_native=use_native)
+            # live client connections, so kill() can sever them the way a
+            # dying process would (shutdown() alone only stops the accept
+            # loop; per-connection daemon threads keep serving)
+            self._conns: set = set()
+            self._conns_lock = threading.Lock()
+            from ..common.config import get_config
 
-        cfg = get_config()
-        self.profiler: Optional[ServerProfiler] = None
-        if cfg.server_enable_profile:
-            self.profiler = ServerProfiler(
-                cfg.server_profile_output_path, cfg.server_key_to_profile)
-            bps_log.info("ps_server: per-key profiling on -> %s",
-                         cfg.server_profile_output_path)
+            cfg = get_config()
+            if cfg.server_enable_profile:
+                self.profiler = ServerProfiler(
+                    cfg.server_profile_output_path, cfg.server_key_to_profile)
+                bps_log.info("ps_server: per-key profiling on -> %s",
+                             cfg.server_profile_output_path)
+        except Exception:
+            super().server_close()
+            raise
+
+    def track_connection(self, sock) -> None:
+        with self._conns_lock:
+            self._conns.add(sock)
+
+    def untrack_connection(self, sock) -> None:
+        with self._conns_lock:
+            self._conns.discard(sock)
+
+    def kill(self) -> None:
+        """Die like a crashed process: stop accepting AND sever every
+        live client connection (clients see a reset, not a quiet stall).
+        Used by chaos tests and the restart-supervision story — a plain
+        ``shutdown()`` leaves per-connection threads serving, which no
+        real shard death does."""
+        self.shutdown()
+        with self._conns_lock:
+            conns, self._conns = set(self._conns), set()
+        for c in conns:
+            hard_reset(c)
+        self.server_close()
 
     def server_close(self):
         if self.profiler is not None:
@@ -342,25 +448,92 @@ def serve(port: int, host: str = "0.0.0.0", use_native: bool = True,
 # -------------------------------------------------------------------- client
 
 
+# wire-level failures (vs store-level status=1 replies, which are final):
+# ConnectionError ⊂ OSError; ValueError/struct.error = corrupt framing
+_WIRE_ERRORS = (OSError, ValueError, struct.error)
+
+
 class RemoteStore:
     """Worker-side client over >=1 PS server shards.
 
     Tensor -> server placement uses the declared-key formula of reference
     global.cc:305-334 so a cluster's key distribution matches the
     reference's load-balance behavior byte for byte.
+
+    Failure semantics (byteps_tpu addition — the reference dies with
+    ps-lite on any server fault; docs/resilience.md):
+
+      * wire-level failures retry under ``RetryPolicy`` (exponential
+        backoff + jitter, per-op deadline) instead of raising on the
+        first ``OSError``; a retried PUSH/PUSH_PULL is version-guarded
+        via ``OP_VERSION`` so a mutation whose reply was lost is not
+        double-applied (exactly-once per key for a single writer);
+      * with >1 shards and ``BYTEPS_FAILOVER`` on (default), a shard
+        that exhausts its retries is marked down and its keys re-route
+        to the deterministic next alive shard, re-initialized there from
+        this client's last-seen global state (degraded mode);
+      * a heartbeat ``FailureDetector`` (``BYTEPS_HEARTBEAT_INTERVAL_MS``
+        or auto-started on first failover) watches the dead shard; when
+        it answers ``OP_PING`` again, failed-over keys migrate back
+        (pull latest from the fallback, re-init the restarted shard).
     """
 
     def __init__(self, addrs: List[str], use_hash: bool = False,
-                 timeout: float = 30.0):
+                 timeout: float = 30.0, retry_policy=None, counters=None,
+                 heartbeat: Optional[float] = None):
+        from ..common.config import get_config
         from ..common.context import ServerSharder
+        from ..resilience import (DegradedModeRouter, RetryPolicy,
+                                  get_counters)
+        from ..resilience import counters as cn
 
         if not addrs:
             raise ValueError("RemoteStore needs at least one server address")
+        cfg = get_config()
         self._addrs = list(addrs)
         self._sharder = ServerSharder(len(addrs), use_hash=use_hash)
         self._socks: List[Optional[socket.socket]] = [None] * len(addrs)
         self._locks = [threading.Lock() for _ in addrs]
         self._timeout = timeout
+        self._cn = cn
+        self._policy = (retry_policy if retry_policy is not None
+                        else RetryPolicy.from_config(cfg))
+        self._counters = counters if counters is not None else get_counters()
+        self._failover_enabled = cfg.failover and len(addrs) > 1
+        # version-guarded retry dedup assumes a single writer per key;
+        # with several workers pushing the same keys the counter is
+        # ambiguous and suppressing a resend silently DROPS a delta —
+        # worse than the at-least-once double-apply async-PS tolerates.
+        # Auto: on only for single-worker clusters; BYTEPS_RETRY_VERSION_GUARD
+        # overrides either way.
+        self._version_guard = (cfg.retry_version_guard
+                               if cfg.retry_version_guard is not None
+                               else cfg.num_worker <= 1)
+        self._router = DegradedModeRouter(len(addrs),
+                                          counters=self._counters)
+        # serializes degraded-mode ops against recovery migration (held
+        # across fallback network I/O — degraded-mode correctness over
+        # degraded-mode latency); healthy-shard ops never take it
+        self._failover_lock = threading.RLock()
+        # guards _last_global/_pushed_version — held only for dict ops,
+        # never across I/O (RLock: nested paths)
+        self._state_lock = threading.RLock()
+        self._last_global: dict = {}      # name -> last seen global value
+        # (name, shard) -> that SHARD's version counter after our last
+        # acknowledged mutation there.  Keyed per shard: during a
+        # failover episode the same name has independent counters on the
+        # primary and the fallback, and comparing across them would
+        # corrupt the retry-dedup decision.
+        self._pushed_version: dict = {}
+        self._hb_interval = cfg.heartbeat_interval_ms / 1e3
+        self._hb_timeout = cfg.heartbeat_timeout_ms / 1e3
+        self._hb_threshold = cfg.heartbeat_miss_threshold
+        self._detector = None
+        hb = self._hb_interval if heartbeat is None else heartbeat
+        if hb and hb > 0:
+            self._start_detector(hb)
+
+    # ------------------------------------------------ sockets & heartbeat
 
     def _sock(self, i: int) -> socket.socket:
         if self._socks[i] is None:
@@ -371,29 +544,349 @@ class RemoteStore:
             self._socks[i] = s
         return self._socks[i]
 
+    def _drop_socket_locked(self, shard: int) -> None:
+        """Drop the (possibly poisoned) cached socket so the next RPC
+        reconnects instead of failing forever.  Caller holds the shard
+        lock."""
+        if self._socks[shard] is not None:
+            try:
+                self._socks[shard].close()
+            except OSError:
+                pass
+            self._socks[shard] = None
+            self._counters.bump(self._cn.RECONNECT, shard=shard)
+
+    def ping_shard(self, shard: int) -> bool:
+        """One-shot short-timeout OP_PING round-trip on a fresh
+        connection — never touches the cached data sockets, so
+        heartbeats cannot contend with (or poison) in-flight ops."""
+        host, port = self._addrs[shard].rsplit(":", 1)
+        try:
+            with socket.create_connection(
+                    (host, int(port)), timeout=self._hb_timeout) as s:
+                s.settimeout(self._hb_timeout)
+                s.sendall(_encode(OP_PING, "", None))
+                status, _, _, _ = _decode(s)
+                return status == 0
+        except _WIRE_ERRORS:
+            return False
+
+    def _start_detector(self, interval: float) -> None:
+        from ..resilience import FailureDetector
+
+        with self._state_lock:  # two racing RPC threads -> one detector
+            if self._detector is None:
+                self._detector = FailureDetector(
+                    len(self._addrs), self.ping_shard, interval=interval,
+                    miss_threshold=self._hb_threshold,
+                    on_down=self._on_shard_down, on_up=self._on_shard_up,
+                    counters=self._counters).start()
+
+    def _ensure_detector(self) -> None:
+        """A failover without a heartbeat would never notice recovery —
+        start one lazily the first time a shard goes down."""
+        if self._detector is None:
+            self._start_detector(self._hb_interval or 0.25)
+
+    def _on_shard_down(self, shard: int) -> None:
+        if self._failover_enabled and self._router.mark_down(shard):
+            self._counters.bump(self._cn.FAILOVER, shard=shard)
+        with self._locks[shard]:
+            self._drop_socket_locked(shard)
+
+    def _on_shard_up(self, shard: int) -> None:
+        """Recovery migration: move every failed-over key back onto the
+        restarted shard, seeding it with the latest global state pulled
+        from its fallback.  Holds the failover lock, so no degraded-mode
+        op can interleave and lose an update."""
+        if not self._failover_enabled:
+            return
+        with self._failover_lock:
+            for name, fb in self._router.failed_over_names(shard):
+                try:
+                    _, out, _ = self._rpc_raw(fb, OP_PULL, name)
+                    val = np.array(out)
+                except Exception:
+                    with self._state_lock:
+                        val = self._last_global.get(name)
+                    if val is None:
+                        continue
+                try:
+                    # force-set: a shard that was merely partitioned (not
+                    # restarted) still holds its pre-partition state,
+                    # which must not shadow the fallback's newer value
+                    rname, _, _ = self._rpc_raw(shard, OP_SET, name, val)
+                except Exception as e:
+                    bps_log.warning(
+                        "failback of %r to shard %d failed (%s); staying "
+                        "degraded", name, shard, e)
+                    # re-arm the detector: it already moved the shard to
+                    # its up set before firing on_up, so without this the
+                    # next successful ping is a no-op and the migration
+                    # would never be retried — permanently degraded
+                    if self._detector is not None:
+                        self._detector.mark_down(shard)
+                    return
+                self._router.clear_failover(name)
+                self._counters.bump(self._cn.REINIT, name=name, shard=shard)
+                self._note_success(OP_SET, name, rname, None, val,
+                                   shard=shard)
+            if self._router.mark_up(shard):
+                self._counters.bump(self._cn.FAILBACK, shard=shard)
+                bps_log.warning("shard %d restored; routing returned to "
+                                "primary placement", shard)
+
+    # --------------------------------------------------------------- RPC
+
     def _shard_of(self, name: str, nbytes: int = 0) -> int:
         return self._sharder.place(name_key(name), nbytes)
 
-    def _rpc(self, shard: int, op: int, name: str,
-             arr: Optional[np.ndarray] = None, raw: bytes = b""):
+    def _rpc_raw(self, shard: int, op: int, name: str,
+                 arr: Optional[np.ndarray] = None, raw: bytes = b"",
+                 op_timeout: Optional[float] = None):
+        """One attempt against one shard; no retry, no routing.
+        ``op_timeout`` clamps the socket timeout for this attempt so a
+        hung shard cannot stall an op past its retry deadline (a blocked
+        read would otherwise wait the full connection timeout)."""
         with self._locks[shard]:
             try:
                 sock = self._sock(shard)
+                sock.settimeout(self._timeout if op_timeout is None
+                                else max(0.05, min(self._timeout,
+                                                   op_timeout)))
                 sock.sendall(_encode(op, name, arr, raw))
-                status, _, out, payload = _decode(sock)
-            except (OSError, ConnectionError):
-                # drop the (possibly poisoned) cached socket so the next
-                # RPC reconnects instead of failing forever
-                if self._socks[shard] is not None:
-                    try:
-                        self._socks[shard].close()
-                    except OSError:
-                        pass
-                    self._socks[shard] = None
+                status, rname, out, payload = _decode(sock)
+            except _WIRE_ERRORS:
+                self._drop_socket_locked(shard)
                 raise
         if status != 0:
             raise RuntimeError(f"ps_server error: {payload.decode()!r}")
+        return rname, out, payload
+
+    def _rpc_once(self, shard: int, op: int, name: str,
+                  arr: Optional[np.ndarray] = None, raw: bytes = b"",
+                  op_timeout: Optional[float] = None):
+        rname, out, payload = self._rpc_raw(shard, op, name, arr, raw,
+                                            op_timeout)
+        if self._detector is not None:
+            self._detector.report_success(shard)
+        self._note_success(op, name, rname, out, arr, shard=shard)
         return out, payload
+
+    def _note_success(self, op: int, name: str, rname: str, out, arr=None,
+                      shard: int = 0):
+        """Record the server-acknowledged version (reply name field,
+        keyed per (name, shard)) and the last seen global value — the
+        failover seed."""
+        if op not in (OP_INIT, OP_SET, OP_PUSH, OP_PUSH_PULL, OP_PULL):
+            return
+        version = int(rname) if rname and rname.isdigit() else None
+        # build the (possibly multi-MB) snapshot copy OUTSIDE the state
+        # lock — concurrent RPC threads must not serialize behind it
+        snap = None
+        if op in (OP_PULL, OP_PUSH_PULL, OP_INIT) and out is not None:
+            # INIT replies carry the store's actual value, so a
+            # first-push-wins loser records the WINNING value here, not
+            # its own rejected seed
+            snap = np.array(out)
+        elif op == OP_SET and arr is not None:
+            # force-set: our value IS the store's value now
+            snap = np.array(arr)
+        elif op == OP_INIT and arr is not None and version == 0:
+            # duck-typed store without a value in the init reply: fall
+            # back to our seed (exact only pre-push)
+            snap = np.array(arr)
+        with self._state_lock:
+            if version is not None:
+                self._pushed_version[(name, shard)] = version
+            if snap is not None:
+                self._last_global[name] = snap
+
+    def _rpc(self, shard: int, op: int, name: str,
+             arr: Optional[np.ndarray] = None, raw: bytes = b""):
+        """Routed, retried RPC — the resilience front door."""
+        primary = shard
+        policy = self._policy
+        deadline = policy.start()
+        attempt = 0
+        reseeded = False
+        while True:
+            # target of THIS attempt: primary, or the fallback when the
+            # router has the primary excluded.  The lock-free route peek
+            # keeps healthy-shard ops off the failover lock entirely; the
+            # re-check under the lock makes fallback ops atomic against
+            # recovery migration.
+            target = primary
+            if (self._failover_enabled
+                    and self._router.route(primary) != primary):
+                with self._failover_lock:
+                    routed = self._router.route(primary)
+                    if routed != primary:
+                        try:
+                            return self._rpc_on_fallback(
+                                primary, routed, op, name, arr, raw)
+                        except _WIRE_ERRORS as e:
+                            err = e
+                            target = routed
+            if target == primary:
+                try:
+                    # clamp this attempt's socket timeout to the time
+                    # left on the op deadline: a hung (not crashed)
+                    # shard must not stall the op past the documented
+                    # BYTEPS_RETRY_DEADLINE_MS bound
+                    remaining = (None if deadline == float("inf")
+                                 else deadline - time.monotonic())
+                    return self._rpc_once(primary, op, name, arr, raw,
+                                          op_timeout=remaining)
+                except _WIRE_ERRORS as e:
+                    err = e
+                except RuntimeError as e:
+                    # store-level errors are final — EXCEPT the one a
+                    # supervised restart manufactures: a shard brought
+                    # back with a fresh store answers ops for tensors it
+                    # no longer holds with KeyError.  Re-seed once from
+                    # the last-seen global state and retry (the recovery
+                    # path for single-shard clusters, where failover can
+                    # never kick in).
+                    if (not reseeded and name and "KeyError" in str(e)
+                            and self._reseed_shard(primary, name)):
+                        reseeded = True
+                        continue
+                    raise
+            attempt += 1
+            if self._detector is not None and target == primary:
+                self._detector.report_failure(primary)
+            if policy.should_retry(attempt, deadline):
+                self._counters.bump(self._cn.RETRY, op=op, name=name,
+                                    shard=target, attempt=attempt)
+                policy.sleep(attempt + 1)
+                if op in (OP_PUSH, OP_PUSH_PULL):
+                    # probe the shard the lost attempt actually hit
+                    resolved = self._resolve_lost_mutation(target, op, name)
+                    if resolved is not None:
+                        return resolved
+                continue
+            # retries exhausted: exclude the shard we kept failing
+            # against — the primary, or a fallback that died too
+            # (cascading failure) — and re-route if that moves the op
+            # anywhere new.  mark_down refuses to exclude the last
+            # alive shard, so this terminates.
+            if self._failover_enabled:
+                if self._router.mark_down(target):
+                    self._counters.bump(self._cn.FAILOVER, shard=target)
+                    self._ensure_detector()
+                    if self._detector is not None:
+                        self._detector.mark_down(target)
+                if self._router.route(primary) != target:
+                    # routing changed (we excluded the target, or the
+                    # heartbeat beat us to it) — try the new home with a
+                    # fresh retry budget: carrying the exhausted counter
+                    # over would give every subsequent shard exactly one
+                    # blip of tolerance and cascade healthy shards out
+                    attempt = 0
+                    continue
+            self._counters.bump(self._cn.GIVE_UP, op=op, name=name,
+                                shard=target)
+            raise err
+
+    def _reseed_shard(self, shard: int, name: str) -> bool:
+        """Force-SET a tensor a shard lost (restart with a fresh store)
+        from this client's last-seen global state.  False when there is
+        nothing to seed from — the KeyError then surfaces unchanged
+        (e.g. a genuinely never-declared name)."""
+        with self._state_lock:
+            seed = self._last_global.get(name)
+        if seed is None:
+            return False
+        try:
+            rname, _, _ = self._rpc_raw(shard, OP_SET, name, seed)
+        except Exception:
+            return False
+        self._counters.bump(self._cn.REINIT, name=name, shard=shard)
+        self._note_success(OP_SET, name, rname, None, seed, shard=shard)
+        bps_log.warning("shard %d lost %r (restarted with a fresh "
+                        "store?); re-seeded from last-seen state",
+                        shard, name)
+        return True
+
+    def _resolve_lost_mutation(self, shard: int, op: int, name: str):
+        """After a wire failure on PUSH/PUSH_PULL, decide whether the
+        lost attempt was applied (reply lost) or not (request lost): if
+        the server's version advanced past the last version it
+        acknowledged to us, the mutation landed — resending would
+        double-apply.  Assumes a single writer per key (concurrent
+        writers make the counter ambiguous; see docs/resilience.md).
+        Returns the op's result when known-applied, else None (resend).
+        """
+        if not self._version_guard:
+            # multiple writers: the counter cannot attribute the advance
+            # to OUR lost push — suppressing would silently drop a delta,
+            # so fall back to at-least-once resend
+            return None
+        with self._state_lock:
+            expected = self._pushed_version.get((name, shard))
+        if expected is None:
+            return None  # no baseline ON THIS SHARD: at-least-once resend
+        # the probe is idempotent, so retry it under the policy itself: a
+        # single-shot probe that happened to hit its own transient fault
+        # would wrongly resend an applied mutation
+        payload = None
+        for probe_attempt in range(self._policy.max_attempts):
+            try:
+                _, _, payload = self._rpc_raw(shard, OP_VERSION, name)
+                break
+            except RuntimeError:
+                return None  # store-level: tensor unknown there
+            except _WIRE_ERRORS:
+                self._policy.sleep(probe_attempt + 2)
+        if payload is None:
+            return None  # probe never got through; resend (at-least-once)
+        v = struct.unpack("<Q", payload)[0]
+        if v <= expected:
+            return None  # not applied; safe to resend
+        with self._state_lock:
+            self._pushed_version[(name, shard)] = v
+        self._counters.bump(self._cn.DEDUP, op=op, name=name, shard=shard)
+        bps_log.debug("retry of %s on %r suppressed: server already at "
+                      "version %d (> %d)", op, name, v, expected)
+        if op == OP_PUSH_PULL:
+            # mutation applied but its reply (the global tensor) was
+            # lost — a plain idempotent PULL recovers it
+            return self._rpc(shard, OP_PULL, name)
+        return None, b""
+
+    def _rpc_on_fallback(self, primary: int, fallback: int, op: int,
+                         name: str, arr, raw):
+        """Degraded mode: serve an op for a key whose primary shard is
+        down.  First touch of a name re-initializes it on the fallback
+        shard from this worker's last-seen global state (the
+        restore-from-worker-state leg of failover).  Caller holds the
+        failover lock (held across the I/O: degraded-mode ops must not
+        interleave with recovery migration, or its final
+        pull-from-fallback could miss an in-flight update)."""
+        if op in (OP_NAMES, OP_PING):
+            return self._rpc_once(fallback, op, name, arr, raw)
+        # re-seed when the name is not yet re-homed OR its ledgered
+        # fallback differs from where routing points now (a cascading
+        # second failure moved the fallback — the new shard has no copy)
+        if self._router.fallback_for(name) != fallback:
+            with self._state_lock:
+                seed = self._last_global.get(name)
+            if seed is not None:
+                # force-set, not init: the fallback may hold a stale
+                # leftover copy from an earlier failover episode, which
+                # first-push-wins INIT would silently keep
+                rname, _, _ = self._rpc_raw(fallback, OP_SET, name, seed)
+                self._counters.bump(self._cn.REINIT, name=name,
+                                    shard=fallback)
+                # adopt the fallback's version counter as the dedup
+                # baseline for this (name, shard) pair
+                self._note_success(OP_SET, name, rname, None, seed,
+                                   shard=fallback)
+            self._router.note_failover(name, primary, fallback)
+            bps_log.warning("shard %d down: %r re-homed to shard %d",
+                            primary, name, fallback)
+        return self._rpc_once(fallback, op, name, arr, raw)
 
     # ------------------------------------------------- store interface
 
@@ -420,22 +913,36 @@ class RemoteStore:
         return struct.unpack("<Q", payload)[0]
 
     def names(self) -> List[str]:
+        """Union of tensor names across shards.  Down shards are skipped
+        (their reachable names live on fallbacks and appear in those
+        listings); the union is deduplicated because a failed-over name
+        exists on both its fallback and, after recovery, its primary."""
         out: List[str] = []
+        seen: set = set()
         for i in range(len(self._addrs)):
+            if self._failover_enabled and self._router.is_down(i):
+                continue
             _, payload = self._rpc(i, OP_NAMES, "")
-            if payload:
-                out.extend(payload.decode().split("\n"))
+            for n in (payload.decode().split("\n") if payload else []):
+                if n and n not in seen:
+                    seen.add(n)
+                    out.append(n)
         return out
 
     def ping(self) -> bool:
-        try:
-            for i in range(len(self._addrs)):
-                self._rpc(i, OP_PING, "")
-            return True
-        except OSError:
-            return False
+        """True iff every shard ADDRESS answers — deliberately not
+        routed through the failover layer (a fallback answering for a
+        dead primary must not make the cluster look healthy)."""
+        return all(self.ping_shard(i) for i in range(len(self._addrs)))
+
+    def health(self) -> List[bool]:
+        """Per-shard routing health (True = primary placement active)."""
+        return [not self._router.is_down(i) for i in range(len(self._addrs))]
 
     def close(self) -> None:
+        if self._detector is not None:
+            self._detector.stop()
+            self._detector = None
         for i, s in enumerate(self._socks):
             if s is not None:
                 try:

@@ -20,6 +20,14 @@ The same env names keep working so reference run scripts port directly:
                                            notice (sync mode needs no tier)
   BYTEPS_ENABLE_GDB=1                   -> wrap the command in gdb
                                            (launcher/launch.py:37-40)
+  BYTEPS_SERVER_MAX_RESTARTS=N          -> supervise the server role:
+                                           restart a crashed PS shard up
+                                           to N times (fresh store; the
+                                           workers' degraded-mode client
+                                           re-initializes state on
+                                           recovery — docs/resilience.md)
+  BYTEPS_SERVER_RESTART_BACKOFF_MS      -> pause between restarts
+                                           (default 1000)
 
 Usage::
 
@@ -36,6 +44,35 @@ from __future__ import annotations
 import os
 import subprocess
 import sys
+import time
+
+
+def _serve_supervised(serve, port: int, env: dict) -> int:
+    """Run one PS shard, restarting on crash up to
+    ``BYTEPS_SERVER_MAX_RESTARTS`` times (0 = the old die-on-crash
+    behavior).  Each restart binds the same port with a fresh store; the
+    resilience layer on the worker side re-initializes tensor state when
+    its heartbeat sees the shard answer again."""
+    max_restarts = int(env.get("BYTEPS_SERVER_MAX_RESTARTS", "0") or "0")
+    backoff = float(env.get("BYTEPS_SERVER_RESTART_BACKOFF_MS", "1000")) / 1e3
+    attempt = 0
+    while True:
+        try:
+            serve(port)
+            return 0
+        except KeyboardInterrupt:
+            return 0
+        except Exception as e:
+            attempt += 1
+            if attempt > max_restarts:
+                print(f"byteps_tpu.launcher: PS shard crashed ({e!r}); "
+                      f"restart budget exhausted ({max_restarts})",
+                      file=sys.stderr)
+                return 1
+            print(f"byteps_tpu.launcher: PS shard crashed ({e!r}); "
+                  f"restart {attempt}/{max_restarts} in {backoff:.1f}s",
+                  file=sys.stderr)
+            time.sleep(backoff)
 
 
 def _check_env(env: dict) -> None:
@@ -81,8 +118,7 @@ def main(argv=None) -> int:
             root = int(env.get("DMLC_PS_ROOT_PORT", "1234"))
             server_id = int(env.get("DMLC_SERVER_ID", "0"))
             port = int(env.get("BYTEPS_SERVER_PORT", str(root + 100 + server_id)))
-            ps_server.serve(port)
-            return 0
+            return _serve_supervised(ps_server.serve, port, env)
         print(
             "byteps_tpu.launcher: role 'server' is only needed for async-PS "
             "mode (BYTEPS_ENABLE_ASYNC=1); in sync mode XLA collectives "

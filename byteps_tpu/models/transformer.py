@@ -868,6 +868,22 @@ def init_cache(cfg: TransformerConfig, batch_size: int, max_len: int,
     KV, D = cfg.kv_heads, cfg.d_head
     if layout not in ("auto", "flat", "grouped"):
         raise ValueError(f"unknown cache layout {layout!r}")
+    if layout == "flat" and cfg.mesh is not None:
+        names = cfg.mesh.axis_names
+        tp = cfg.tp_axis
+        if (tp in names and cfg.mesh.shape[tp] > 1
+                and KV % cfg.mesh.shape[tp] == 0):
+            # the grouped path would shard the KV head axis over tp
+            # (_grouped_cache_sharding); the flat [B, S, KV*D] stream has
+            # no head axis to shard, so honoring the request would
+            # silently collapse the per-shard KV streams onto every
+            # device — refuse instead (layout="auto" already routes
+            # sharded decode to the grouped path)
+            raise ValueError(
+                f'layout="flat" is incompatible with an active tensor-'
+                f'parallel axis {tp!r} (size {cfg.mesh.shape[tp]}) '
+                f'dividing kv_heads={KV}; use layout="auto" or "grouped" '
+                f'for sharded decode')
     if layout == "auto":
         from ..ops.decode_attention import decode_attention_usable
 

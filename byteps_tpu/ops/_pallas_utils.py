@@ -8,6 +8,19 @@ import math
 import jax
 
 
+def tpu_compiler_params(**kwargs):
+    """Version-portable ``pltpu.CompilerParams``: older jax (<=0.4.x)
+    spells it ``TPUCompilerParams``, newer jax renamed it.  Every kernel
+    builds its params through this shim so the ops import (and run in
+    interpret mode on CPU) on both."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None)
+    if cls is None:
+        cls = getattr(pltpu, "TPUCompilerParams")
+    return cls(**kwargs)
+
+
 def resolve_interpret(interpret) -> bool:
     """None = auto: interpret mode off TPU (CPU tests / virtual meshes),
     compiled Mosaic kernels on TPU."""

@@ -59,7 +59,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ._pallas_utils import resolve_interpret
+from ._pallas_utils import resolve_interpret, tpu_compiler_params
 
 # Default S-chunk. 512 rows x KV*D lanes of bf16 K + V double-buffered
 # stays well inside VMEM at any sane KV*D (H=12 MHA: 2 * 2 * 512*768*2B
@@ -279,7 +279,7 @@ def decode_attention(q, ck, cv, pos, *, k_scale=None, v_scale=None,
                           window=window, quant=quant, cdt=q.dtype),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Hp, KVD), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(pos_arr, *operands)

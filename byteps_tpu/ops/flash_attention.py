@@ -42,7 +42,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ._pallas_utils import fit_block as _fit_block_impl, resolve_interpret
+from ._pallas_utils import fit_block as _fit_block_impl, resolve_interpret, tpu_compiler_params
 
 # Tuned on TPU v5e at T=4096 bf16 (D=64 and D=128): (1024, 1024) beats
 # (512, 1024) by ~3-4% fwd+bwd and (128, 128) by >4x — big blocks amortize
@@ -64,6 +64,16 @@ DEFAULT_BLOCK_K = 1024
 DEFAULT_BWD_DQ_BLOCKS = (1024, 1024)   # (block_q, block_k) of _bwd_dq
 DEFAULT_BWD_DKV_BLOCKS = (1024, 1024)  # (block_q, block_k) of _bwd_dkv
 _NEG_INF = -1e30
+
+
+def _fwd_blocks(block_q, block_k):
+    """Resolve the public ``None`` block defaults to the fwd-tuned
+    shapes.  The public API defaults are ``None`` (not the tuned ints)
+    so the backward can tell an explicit caller choice of 1024x1024
+    apart from "caller didn't care" — only the latter may be overridden
+    by the independently swept bwd defaults."""
+    return (DEFAULT_BLOCK_Q if block_q is None else block_q,
+            DEFAULT_BLOCK_K if block_k is None else block_k)
 
 
 def _resolve_interpret(interpret) -> bool:
@@ -294,7 +304,7 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
             pltpu.VMEM((bq, 1), jnp.float32),   # running max
             pltpu.VMEM((bq, 1), jnp.float32),   # running sum
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -309,8 +319,8 @@ def flash_attention(
     v: jax.Array,
     causal: bool = False,
     scale: Optional[float] = None,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_k: int = DEFAULT_BLOCK_K,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
     segment_ids: Optional[jax.Array] = None,
     window: Optional[int] = None,
@@ -338,9 +348,16 @@ def flash_attention(
     adds the ALiBi position bias ``slope_h * (j - i)`` to the scores —
     computed from iotas inside the kernel, so no [T, T] bias tensor ever
     exists.  Slopes are treated as constants (zero cotangent): ALiBi
-    slopes are fixed by the head-count formula in practice, not learned."""
+    slopes are fixed by the head-count formula in practice, not learned.
+
+    ``block_q``/``block_k`` default to ``None`` = the tuned defaults
+    (``DEFAULT_BLOCK_Q/K`` forward, the independently swept
+    ``DEFAULT_BWD_*`` shapes backward).  Passing explicit values binds
+    all three kernels to that choice — including an explicit 1024x1024,
+    e.g. when a VMEM budget forces the shape."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    o, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+    bq, bk = _fwd_blocks(block_q, block_k)
+    o, _ = _flash_forward(q, k, v, causal, scale, bq, bk,
                           interpret, segment_ids, window, alibi_slopes)
     return o
 
@@ -348,7 +365,8 @@ def flash_attention(
 def _fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret,
               segment_ids, window, alibi_slopes):
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    o, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+    bq, bk = _fwd_blocks(block_q, block_k)
+    o, lse = _flash_forward(q, k, v, causal, scale, bq, bk,
                             interpret, segment_ids, window, alibi_slopes)
     return o, (q, k, v, o, lse, segment_ids, alibi_slopes)
 
@@ -548,7 +566,7 @@ def _flash_backward(q, k, v, o, lse, do, dlse, causal, scale, block_q,
 
     nk1, nq1 = T // bk1, T // bq1
     nk2, nq2 = T // bk2, T // bq2
-    arb = pltpu.CompilerParams(
+    arb = tpu_compiler_params(
         dimension_semantics=("parallel", "parallel", "arbitrary"))
 
     if causal:
@@ -676,12 +694,15 @@ def _flash_backward(q, k, v, o, lse, do, dlse, causal, scale, block_q,
 
 def _bwd_blocks(block_q, block_k):
     """Per-kernel bwd block shapes: the swept defaults when the caller
-    left (block_q, block_k) at the fwd-tuned defaults, else the caller's
-    explicit choice for both kernels (a VMEM-forced small block must
-    bind the bwd too)."""
-    if (block_q, block_k) == (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K):
+    left (block_q, block_k) unset (``None`` — the public defaults), else
+    the caller's explicit choice for both kernels (a VMEM-forced small
+    block must bind the bwd too).  Because the public defaults are
+    ``None``, an explicit 1024x1024 is distinguishable from "defaults"
+    and is honored as a caller choice."""
+    if block_q is None and block_k is None:
         return DEFAULT_BWD_DQ_BLOCKS, DEFAULT_BWD_DKV_BLOCKS
-    return (block_q, block_k), (block_q, block_k)
+    bq, bk = _fwd_blocks(block_q, block_k)
+    return (bq, bk), (bq, bk)
 
 
 def _bwd_rule(causal, scale, block_q, block_k, interpret, window, res, do):
@@ -689,8 +710,9 @@ def _bwd_rule(causal, scale, block_q, block_k, interpret, window, res, do):
 
     q, k, v, o, lse, segment_ids, alibi_slopes = res
     dq_b, dkv_b = _bwd_blocks(block_q, block_k)
+    bq, bk = _fwd_blocks(block_q, block_k)
     dq, dk, dv = _flash_backward(q, k, v, o, lse, do, None, causal, scale,
-                                 block_q, block_k, interpret, segment_ids,
+                                 bq, bk, interpret, segment_ids,
                                  window, alibi_slopes,
                                  dq_blocks=dq_b, dkv_blocks=dkv_b)
     dseg = (None if segment_ids is None
@@ -710,8 +732,8 @@ def flash_attention_with_lse(
     v: jax.Array,
     causal: bool = False,
     scale: Optional[float] = None,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_k: int = DEFAULT_BLOCK_K,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
 ):
     """Forward returning ``(o, lse)`` with ``lse: [B, T, H]`` — the
@@ -725,7 +747,8 @@ def flash_attention_with_lse(
 
 def _lse_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     scale_v = scale if scale is not None else q.shape[-1] ** -0.5
-    o, lse_bh = _flash_forward(q, k, v, causal, scale_v, block_q, block_k,
+    bq, bk = _fwd_blocks(block_q, block_k)
+    o, lse_bh = _flash_forward(q, k, v, causal, scale_v, bq, bk,
                                interpret)
     B, T, H, D = q.shape
     lse = lse_bh.reshape(B, H, T).transpose(0, 2, 1)  # [B, T, H]
@@ -751,8 +774,9 @@ def _lse_bwd_rule(causal, scale, block_q, block_k, interpret, res, cts):
         dlse3 = dlse.transpose(0, 2, 1).reshape(B * H, T)[..., None]
         dlse3 = dlse3.astype(jnp.float32)
     dq_b, dkv_b = _bwd_blocks(block_q, block_k)
+    bq, bk = _fwd_blocks(block_q, block_k)
     return _flash_backward(q, k, v, o, lse_bh, do, dlse3, causal, scale,
-                           block_q, block_k, interpret,
+                           bq, bk, interpret,
                            dq_blocks=dq_b, dkv_blocks=dkv_b)
 
 

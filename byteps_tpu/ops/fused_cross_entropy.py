@@ -49,7 +49,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ._pallas_utils import fit_block as _fit, resolve_interpret as _resolve_interpret
+from ._pallas_utils import fit_block as _fit, resolve_interpret as _resolve_interpret, tpu_compiler_params
 
 # tuned on v5e at H=768, V=32k; explicit user blocks bypass the VMEM caps
 DEFAULT_BLOCK_N = 512
@@ -202,7 +202,7 @@ def _fce_forward(x, w, targets, block_n, block_v, interpret):
             pltpu.VMEM((bn, 1), jnp.float32),
             pltpu.VMEM((bn, 1), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -259,7 +259,7 @@ def _fce_bwd_rule(block_n, block_v, interpret, res, dloss):
     # (softmax - onehot) * 0 — no gradient flows from them to x or W
     valid = (tgt >= 0) & (tgt < V)
     dl = dloss.astype(jnp.float32).reshape(N, 1) * valid
-    arb = pltpu.CompilerParams(
+    arb = tpu_compiler_params(
         dimension_semantics=("parallel", "arbitrary"))
 
     dx = pl.pallas_call(
@@ -292,7 +292,7 @@ def _fce_bwd_rule(block_n, block_v, interpret, res, dloss):
         out_specs=pl.BlockSpec((H, bv), lambda vi, i: (0, vi)),
         out_shape=jax.ShapeDtypeStruct((H, V), w.dtype),
         scratch_shapes=[pltpu.VMEM((H, bv), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret_b,

@@ -1,0 +1,54 @@
+"""Resilience subsystem: retry, failure detection, degraded-mode routing
+and deterministic fault injection for the parameter-server tier.
+
+The reference (BytePS) inherits whatever fault behavior ps-lite has —
+in practice a dead server kills the job.  The ROADMAP north star is a
+production-scale system, and production PS clusters lose server shards,
+hit transient timeouts and see stragglers, so this package gives the
+TCP tier (engine/ps_server.py) first-class failure semantics:
+
+  * ``RetryPolicy`` (policy.py) — bounded exponential backoff + jitter
+    with a per-op deadline; consulted by ``RemoteStore._rpc`` instead of
+    raising on the first ``OSError``.  Retried mutations are version-
+    guarded (``OP_VERSION``) so a push whose reply was lost is not
+    double-applied.
+  * ``FailureDetector`` (detector.py) — heartbeat thread pinging shards
+    (``OP_PING`` on short-timeout one-shot connections), publishing
+    per-shard health and firing down/up callbacks.
+  * ``DegradedModeRouter`` (router.py) — excludes dead shards from key
+    placement (deterministic next-alive-shard remap via
+    ``ServerSharder.remap``) and tracks which keys were failed over so
+    they migrate back on recovery.
+  * ``FaultInjectingProxy`` (chaos.py) — a protocol-aware TCP shim
+    between ``RemoteStore`` and ``PSServer`` that drops / delays /
+    garbles / resets individual requests deterministically (scripted or
+    seeded-random), so every policy path is exercised in tests without
+    real network failures.
+  * ``ResilienceCounters`` (counters.py) — retries, reconnects,
+    heartbeat misses, failovers, failbacks, re-inits — exported through
+    the existing ``Tracer`` as chrome-trace counter + instant events so
+    operators see resilience activity on the same timeline as push/pull.
+
+Env knobs (see common/config.py): ``BYTEPS_RETRY_MAX_ATTEMPTS``,
+``BYTEPS_RETRY_BACKOFF_MS``, ``BYTEPS_RETRY_BACKOFF_MULT``,
+``BYTEPS_RETRY_JITTER``, ``BYTEPS_RETRY_DEADLINE_MS``,
+``BYTEPS_HEARTBEAT_INTERVAL_MS``, ``BYTEPS_HEARTBEAT_TIMEOUT_MS``,
+``BYTEPS_HEARTBEAT_MISS_THRESHOLD``, ``BYTEPS_FAILOVER``.
+Semantics are documented in docs/resilience.md.
+"""
+
+from .counters import ResilienceCounters, get_counters, reset_counters
+from .detector import FailureDetector
+from .policy import RetryPolicy
+from .router import DegradedModeRouter
+from .chaos import FaultInjectingProxy
+
+__all__ = [
+    "ResilienceCounters",
+    "get_counters",
+    "reset_counters",
+    "FailureDetector",
+    "RetryPolicy",
+    "DegradedModeRouter",
+    "FaultInjectingProxy",
+]

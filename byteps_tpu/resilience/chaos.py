@@ -1,0 +1,231 @@
+"""Deterministic fault injection between ``RemoteStore`` and ``PSServer``.
+
+``FaultInjectingProxy`` is a TCP shim that speaks the PS wire framing
+(engine/ps_server.py): it reads one complete request frame from the
+client, consults its fault plan, forwards the frame to the real server,
+reads the complete reply frame and relays it back.  Operating on frame
+boundaries (not raw bytes) makes faults *per-request* and exactly
+reproducible:
+
+  * ``"drop_before"`` — connection reset before the server sees the op
+    (retry must resend: the mutation was NOT applied);
+  * ``"drop_after"``  — op forwarded and applied, reply discarded,
+    connection reset (the ambiguous case: a naive retry double-applies —
+    this is the fault the version-guard exists for);
+  * ``("delay", s)``  — hold the request ``s`` seconds before forwarding
+    (exercises timeouts/stragglers);
+  * ``"garble_reply"`` — corrupt the reply header so the client's
+    decoder errors (exercises the poisoned-socket drop + reconnect);
+  * ``"pass"`` / None — forward untouched.
+
+Faults come from a scripted FIFO (``script(...)`` — consumed one per
+request, exact) and/or seeded random rates (``set_rates`` — reproducible
+via the constructor seed).  ``blackhole(True)`` makes the proxy accept
+connections but answer nothing (a hung, not crashed, shard — distinct
+from closing the listener, which looks like a dead host).
+
+Only test/chaos code imports this module; the data path never does.
+"""
+
+from __future__ import annotations
+
+import collections
+import random
+import socket
+import struct
+import threading
+import time
+from typing import List, Optional, Tuple, Union
+
+from ..common import logging as bps_log
+# one wire framing, one reader: a protocol change in the PS tier must
+# break the proxy loudly at import/parse time, not silently diverge
+from ..engine.ps_server import _recv_exact, hard_reset
+
+Fault = Union[str, Tuple[str, float], None]
+
+
+def _read_frame(sock: socket.socket) -> bytes:
+    """Read one complete wire frame (request or reply — same layout)."""
+    head = _recv_exact(sock, 5)
+    _, nlen = struct.unpack("<BI", head)
+    name = _recv_exact(sock, nlen)
+    dlen_b = _recv_exact(sock, 4)
+    (dlen,) = struct.unpack("<I", dlen_b)
+    dt = _recv_exact(sock, dlen)
+    ndim_b = _recv_exact(sock, 1)
+    (ndim,) = struct.unpack("<B", ndim_b)
+    shape = _recv_exact(sock, 8 * ndim)
+    plen_b = _recv_exact(sock, 8)
+    (plen,) = struct.unpack("<Q", plen_b)
+    payload = _recv_exact(sock, plen)
+    return head + name + dlen_b + dt + ndim_b + shape + plen_b + payload
+
+
+class FaultInjectingProxy:
+    """One proxy instance fronts one PS shard; point ``RemoteStore`` at
+    ``proxy.addr`` instead of the real server address."""
+
+    def __init__(self, target: str, seed: int = 0, host: str = "127.0.0.1"):
+        self._target = target
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._script: "collections.deque[Fault]" = collections.deque()
+        self._drop_before_rate = 0.0
+        self._drop_after_rate = 0.0
+        self._delay = 0.0
+        self._garble_rate = 0.0
+        self._blackhole = False
+        self._closed = threading.Event()
+        self._conns: List[socket.socket] = []
+        self.requests_seen = 0
+        self.faults_injected = 0
+
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, 0))
+        self._listener.listen(16)
+        self._host = host
+        self._port = self._listener.getsockname()[1]
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="bps-chaos-accept", daemon=True)
+        self._accept_thread.start()
+
+    # ------------------------------------------------------------------ knobs
+
+    @property
+    def addr(self) -> str:
+        return f"{self._host}:{self._port}"
+
+    def script(self, *faults: Fault) -> None:
+        """Queue faults consumed one per subsequent request (FIFO).
+        ``None``/"pass" entries let a request through untouched."""
+        with self._lock:
+            self._script.extend(faults)
+
+    def set_rates(self, drop_before: float = 0.0, drop_after: float = 0.0,
+                  garble: float = 0.0, delay: float = 0.0) -> None:
+        """Random faults (seeded — reproducible for a fixed seed and
+        request order).  ``delay`` is seconds applied to every request."""
+        with self._lock:
+            self._drop_before_rate = drop_before
+            self._drop_after_rate = drop_after
+            self._garble_rate = garble
+            self._delay = delay
+
+    def blackhole(self, on: bool = True) -> None:
+        """Accept but never answer (hung shard).  Existing connections
+        are reset so in-flight clients fail fast rather than block."""
+        with self._lock:
+            self._blackhole = on
+            conns, self._conns = self._conns, []
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self.blackhole(False)  # also resets lingering connections
+
+    # ------------------------------------------------------------------ loops
+
+    def _accept_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                client, _ = self._listener.accept()
+            except OSError:
+                return
+            with self._lock:
+                self._conns.append(client)
+            threading.Thread(target=self._serve_conn, args=(client,),
+                             daemon=True).start()
+
+    def _next_fault(self) -> Fault:
+        with self._lock:
+            self.requests_seen += 1
+            if self._script:
+                return self._script.popleft()
+            if self._blackhole:
+                return "blackhole"
+            if self._drop_before_rate and self._rng.random() < self._drop_before_rate:
+                return "drop_before"
+            if self._drop_after_rate and self._rng.random() < self._drop_after_rate:
+                return "drop_after"
+            if self._garble_rate and self._rng.random() < self._garble_rate:
+                return "garble_reply"
+            if self._delay:
+                return ("delay", self._delay)
+            return None
+
+    def _serve_conn(self, client: socket.socket) -> None:
+        upstream: Optional[socket.socket] = None
+        try:
+            client.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            host, port = self._target.rsplit(":", 1)
+            while not self._closed.is_set():
+                try:
+                    frame = _read_frame(client)
+                except (ConnectionError, OSError):
+                    return
+                fault = self._next_fault()
+                if fault in (None, "pass"):
+                    pass
+                elif fault == "blackhole":
+                    # swallow the request; never reply — the client's
+                    # socket timeout (or heartbeat) must notice
+                    self.faults_injected += 1
+                    continue
+                elif fault == "drop_before":
+                    self.faults_injected += 1
+                    bps_log.debug("chaos: drop_before request #%d",
+                                  self.requests_seen)
+                    self._reset(client)
+                    return
+                elif isinstance(fault, tuple) and fault[0] == "delay":
+                    self.faults_injected += 1
+                    time.sleep(float(fault[1]))
+                if upstream is None:
+                    upstream = socket.create_connection((host, int(port)),
+                                                        timeout=30.0)
+                    upstream.setsockopt(socket.IPPROTO_TCP,
+                                        socket.TCP_NODELAY, 1)
+                upstream.sendall(frame)
+                reply = _read_frame(upstream)
+                if fault == "drop_after":
+                    self.faults_injected += 1
+                    bps_log.debug("chaos: drop_after request #%d (applied, "
+                                  "reply discarded)", self.requests_seen)
+                    self._reset(client)
+                    return
+                if fault == "garble_reply":
+                    self.faults_injected += 1
+                    # corrupt the name-length field: the client decoder
+                    # hits its sanity bound and poisons the socket
+                    reply = reply[:1] + b"\xff\xff\xff\xff" + reply[5:]
+                    try:
+                        client.sendall(reply)
+                    except OSError:
+                        pass
+                    self._reset(client)
+                    return
+                client.sendall(reply)
+        except (ConnectionError, OSError) as e:
+            bps_log.debug("chaos proxy conn exit: %s", e)
+        finally:
+            for s in (client, upstream):
+                if s is not None:
+                    try:
+                        s.close()
+                    except OSError:
+                        pass
+
+    @staticmethod
+    def _reset(sock: socket.socket) -> None:
+        """Hard RST (not FIN) so the client sees ECONNRESET mid-RPC."""
+        hard_reset(sock)

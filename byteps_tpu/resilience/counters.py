@@ -1,0 +1,88 @@
+"""Resilience event counters, surfaced through the process Tracer.
+
+One process-wide ``ResilienceCounters`` instance (``get_counters()``)
+accumulates named monotonic counts.  Every bump also emits two
+chrome-trace events onto the shared ``Tracer`` when ``BYTEPS_TRACE_PATH``
+is set: an instant event (the moment the retry/failover happened, with
+its args) and a counter event (the running total as a value track) — so
+resilience activity lands on the same timeline the engine's push/pull
+spans already use (the operator story of reference docs/timeline.md).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from ..common import logging as bps_log
+
+# canonical counter names (free-form names are allowed; these are the
+# ones the subsystem itself emits)
+RETRY = "resilience.retry"
+RECONNECT = "resilience.reconnect"
+HEARTBEAT_MISS = "resilience.heartbeat_miss"
+SHARD_DOWN = "resilience.shard_down"
+SHARD_UP = "resilience.shard_up"
+FAILOVER = "resilience.failover"
+FAILBACK = "resilience.failback"
+REINIT = "resilience.reinit"
+GIVE_UP = "resilience.give_up"
+DEDUP = "resilience.retry_dedup"  # retried mutation found already applied
+DISPATCH_FAILURE = "resilience.engine_dispatch_failure"
+TASK_FAILURE = "resilience.engine_task_failure"
+
+
+class ResilienceCounters:
+    """Thread-safe monotonic counters with Tracer surfacing."""
+
+    def __init__(self, tracer=None):
+        self._counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._tracer = tracer
+
+    def _get_tracer(self):
+        if self._tracer is not None:
+            return self._tracer
+        from ..common.tracing import get_tracer
+
+        return get_tracer()
+
+    def bump(self, counter: str, n: int = 1, **args) -> int:
+        with self._lock:
+            total = self._counts.get(counter, 0) + n
+            self._counts[counter] = total
+        tracer = self._get_tracer()
+        if tracer.enabled:
+            # "name" would collide with instant()'s own first parameter
+            safe = {("tensor" if k == "name" else k): v
+                    for k, v in args.items()}
+            tracer.instant(counter, "resilience", **safe)
+            tracer.counter(counter, total, "resilience")
+        bps_log.debug("%s -> %d %s", counter, total, args or "")
+        return total
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+
+_counters: Optional[ResilienceCounters] = None
+_counters_lock = threading.Lock()
+
+
+def get_counters() -> ResilienceCounters:
+    global _counters
+    with _counters_lock:
+        if _counters is None:
+            _counters = ResilienceCounters()
+        return _counters
+
+
+def reset_counters() -> None:
+    global _counters
+    with _counters_lock:
+        _counters = None

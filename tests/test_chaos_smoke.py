@@ -1,0 +1,25 @@
+"""CI wiring for scripts/chaos_smoke.py: a 2-shard PS cluster under
+seeded random faults must reach bit-for-bit the no-fault parameters.
+
+Marked ``slow`` so tier-1 (-m 'not slow') stays fast; run explicitly
+with ``pytest -m slow tests/test_chaos_smoke.py``.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
+
+
+@pytest.mark.slow
+def test_chaos_smoke_bitwise_convergence():
+    import chaos_smoke
+
+    stats = chaos_smoke.run(steps=40, seed=0, rate=0.15, verbose=False)
+    assert stats["faults"] > 0
+    # the deduplication path (applied + reply lost) must have fired at
+    # least once across 160 mutating requests at a 5% drop_after rate —
+    # if not, the seed changed the mix; bump steps rather than ignore
+    assert stats.get("resilience.retry", 0) > 0

@@ -48,3 +48,38 @@ def test_launcher_runs_command(monkeypatch):
     monkeypatch.setenv("DMLC_ROLE", "worker")
     monkeypatch.setenv("DMLC_NUM_WORKER", "1")
     assert main([sys.executable, "-c", "import os; assert os.environ['BYTEPS_LOCAL_RANK'] == '0'"]) == 0
+
+
+def test_server_role_supervision_restarts_crashed_shard():
+    """BYTEPS_SERVER_MAX_RESTARTS: the server role restarts a crashed PS
+    shard (fresh serve() call, same port) up to the budget, then gives
+    up with exit 1."""
+    from byteps_tpu.launcher import _serve_supervised
+
+    calls = []
+
+    def crashy_serve(port):
+        calls.append(port)
+        if len(calls) < 3:
+            raise OSError("simulated shard crash")
+
+    env = {"BYTEPS_SERVER_MAX_RESTARTS": "5",
+           "BYTEPS_SERVER_RESTART_BACKOFF_MS": "1"}
+    assert _serve_supervised(crashy_serve, 1234, env) == 0
+    assert calls == [1234, 1234, 1234]  # crashed twice, third run served
+
+    calls.clear()
+
+    def always_crash(port):
+        calls.append(port)
+        raise OSError("boom")
+
+    env = {"BYTEPS_SERVER_MAX_RESTARTS": "2",
+           "BYTEPS_SERVER_RESTART_BACKOFF_MS": "1"}
+    assert _serve_supervised(always_crash, 1234, env) == 1
+    assert len(calls) == 3  # initial try + 2 restarts
+
+    # default: old die-on-crash behavior (no restarts)
+    calls.clear()
+    assert _serve_supervised(always_crash, 1234, {}) == 1
+    assert len(calls) == 1
