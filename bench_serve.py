@@ -1,0 +1,162 @@
+"""Serving-engine benchmark: continuous batching vs sequential generate().
+
+Measures what the serving tier buys over the one-shot inference path it
+wraps — aggregate decode throughput when concurrent requests share one
+batched decode program instead of each paying a private B=1 loop:
+
+  * **sequential baseline** — the same prompts run one-by-one through
+    ``inference.generate()`` (each request owns the machine, B=1);
+  * **engine @ C** — C requests submitted together to the continuous-
+    batching engine (slot pool >= C, one vmapped decode step per tick),
+    at C = 1 / 4 / 8 / 16.
+
+Reported per point: aggregate tokens/sec, speedup vs sequential, TTFT
+p50/p99, TPOT p50, queue wait — plus the engine's compile counts (each
+point's decode program must trace exactly once, during warmup; a
+retrace in the timed window would mean steady-state serving
+recompiles, the failure mode the static slot design exists to
+prevent).
+
+Prints ONE JSON line per point (bench_comm.py convention) and writes
+the aggregate to BENCH_SERVE.json.  Runs anywhere:
+
+    JAX_PLATFORMS=cpu python bench_serve.py [--tokens 32] [--out ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from byteps_tpu.inference import generate  # noqa: E402
+from byteps_tpu.models.transformer import (  # noqa: E402
+    Transformer,
+    TransformerConfig,
+)
+from byteps_tpu.serving import ServeMetrics, ServingEngine  # noqa: E402
+
+
+def _prompts(n, length, vocab):
+    return [np.asarray(jax.random.randint(
+        jax.random.PRNGKey(100 + i), (length,), 0, vocab), np.int32)
+        for i in range(n)]
+
+
+def bench(tokens: int = 64, prompt_len: int = 16, slots: int = 16,
+          d_model: int = 384, layers: int = 4, vocab: int = 256,
+          concurrency=(1, 4, 8, 16), out_path: str = "BENCH_SERVE.json"):
+    cfg = TransformerConfig(
+        vocab_size=vocab, num_layers=layers, num_heads=4,
+        d_model=d_model, d_ff=4 * d_model,
+        max_seq_len=max(128, prompt_len + tokens + 16),
+        dtype=jnp.float32)
+    model = Transformer(cfg)
+    variables = model.init(jax.random.PRNGKey(1),
+                           jnp.zeros((1, 8), jnp.int32))
+    max_c = max(concurrency)
+    prompts = _prompts(max_c, prompt_len, vocab)
+
+    # ---- sequential baseline: one generate() per request, B=1 --------
+    warm = generate(model, variables, prompts[0][None], tokens,
+                    temperature=0.0)
+    jax.block_until_ready(warm["tokens"])
+    t0 = time.perf_counter()
+    for p in prompts[:max_c]:
+        out = generate(model, variables, p[None], tokens, temperature=0.0)
+        jax.block_until_ready(out["tokens"])
+    seq_elapsed = time.perf_counter() - t0
+    seq_tps = max_c * tokens / seq_elapsed
+    seq_point = {"mode": "sequential", "concurrency": 1,
+                 "requests": max_c, "tokens_per_request": tokens,
+                 "elapsed_s": round(seq_elapsed, 4),
+                 "tokens_per_sec": round(seq_tps, 2)}
+    print(json.dumps(seq_point))
+
+    # ---- engine sweep: pool sized to the concurrency point (a serving
+    # deployment sizes its slot pool to its target batch; oversized
+    # pools pay the full pool's decode for idle slots) ----------------
+    points = [seq_point]
+    counts = {}
+    for c in concurrency:
+        engine = ServingEngine(model, variables, n_slots=min(c, slots),
+                               max_seq=cfg.max_seq_len, temperature=0.0,
+                               max_queue=4 * max_c,
+                               metrics=ServeMetrics())
+        engine.start()
+        # warmup: compile this pool size's prefill bucket + decode
+        # before the timed window
+        engine.submit(prompts[0], tokens)
+        engine.drain(timeout=600)
+        engine.metrics = ServeMetrics()  # fresh percentiles per point
+        t0 = time.perf_counter()
+        reqs = [engine.submit(prompts[i], tokens) for i in range(c)]
+        engine.drain(timeout=600)
+        elapsed = time.perf_counter() - t0
+        for r in reqs:
+            assert len(r.result()) == tokens
+        summ = engine.metrics.summary()
+        counts = engine.compile_counts()
+        engine.stop()
+        # steady state never retraced: warmup compiled the decode
+        # program once; the timed requests reused it
+        assert counts["decode"] == 1, (
+            f"decode retraced during the timed window: {counts}")
+        tps = c * tokens / elapsed
+        point = {
+            "mode": "engine", "concurrency": c, "requests": c,
+            "n_slots": min(c, slots),
+            "tokens_per_request": tokens,
+            "elapsed_s": round(elapsed, 4),
+            "tokens_per_sec": round(tps, 2),
+            "speedup_vs_sequential": round(
+                tps / (tokens / (seq_elapsed / max_c)), 3),
+            "ttft_p50_ms": round(summ["ttft_p50_s"] * 1e3, 2),
+            "ttft_p99_ms": round(summ["ttft_p99_s"] * 1e3, 2),
+            "tpot_p50_ms": round(summ["tpot_p50_s"] * 1e3, 2),
+            "queue_wait_p50_ms": round(summ["queue_wait_p50_s"] * 1e3, 2),
+        }
+        points.append(point)
+        print(json.dumps(point))
+    result = {
+        "bench": "serve",
+        "model": {"d_model": d_model, "layers": layers, "vocab": vocab,
+                  "prompt_len": prompt_len, "tokens": tokens,
+                  "slots": slots},
+        "backend": jax.default_backend(),
+        "compile_counts": counts,
+        "points": points,
+    }
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+    print(f"wrote {out_path}")
+    return result
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tokens", type=int, default=64)
+    ap.add_argument("--prompt-len", type=int, default=16)
+    ap.add_argument("--slots", type=int, default=16)
+    ap.add_argument("--d-model", type=int, default=384)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--out", default="BENCH_SERVE.json")
+    args = ap.parse_args(argv)
+    result = bench(tokens=args.tokens, prompt_len=args.prompt_len,
+                   slots=args.slots, d_model=args.d_model,
+                   layers=args.layers, out_path=args.out)
+    pts = {p["concurrency"]: p for p in result["points"]
+           if p["mode"] == "engine"}
+    sp8 = pts.get(8, {}).get("speedup_vs_sequential", 0)
+    print(f"engine @8 concurrent: {sp8}x sequential "
+          f"({'PASS' if sp8 >= 1.5 else 'FAIL'} >= 1.5x)")
+    return 0 if sp8 >= 1.5 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -55,6 +55,11 @@ def _env_float(name: str, default: float) -> float:
     return float(v)
 
 
+def _env_opt_float(name: str) -> Optional[float]:
+    v = os.environ.get(name)
+    return None if v is None or v == "" else float(v)
+
+
 def _env_opt_bool(name: str) -> Optional[bool]:
     """Tri-state: unset/"" -> None (auto), else truthiness like _env_bool."""
     v = os.environ.get(name)
@@ -125,6 +130,20 @@ class Config:
     heartbeat_miss_threshold: int = 3  # consecutive misses -> shard DOWN
     failover: bool = True  # degraded-mode re-routing around dead shards
 
+    # --- serving (byteps_tpu addition — the continuous-batching engine,
+    # byteps_tpu/serving/; see docs/serving.md and docs/env.md) -----------
+    serve_port: int = 9000
+    serve_slots: int = 8          # KV-cache slot pool capacity
+    serve_max_seq: int = 0        # 0 = model's max_seq_len
+    serve_max_queue: int = 64     # bounded admission queue
+    serve_prefill_credits: int = 0  # padded prefill tokens/tick; 0 = auto
+    serve_temperature: float = 0.0  # 0 = greedy (engine-static)
+    serve_top_k: Optional[int] = None
+    serve_top_p: Optional[float] = None
+    serve_eos_id: Optional[int] = None
+    serve_model: str = ""         # "k=v,..." TransformerConfig overrides
+    serve_checkpoint: str = ""    # params checkpoint for the serve role
+
     # --- TPU-specific ----------------------------------------------------
     wire_dtype: str = ""  # "" (no compression) | "bf16" | "fp16"
     mesh_shape: str = ""  # e.g. "dp=8" or "dcn=2,dp=4"; "" = auto
@@ -161,6 +180,18 @@ class Config:
             heartbeat_miss_threshold=_env_int(
                 "BYTEPS_HEARTBEAT_MISS_THRESHOLD", 3),
             failover=_env_bool("BYTEPS_FAILOVER", True),
+            serve_port=_env_int("BYTEPS_SERVE_PORT", 9000),
+            serve_slots=_env_int("BYTEPS_SERVE_SLOTS", 8),
+            serve_max_seq=_env_int("BYTEPS_SERVE_MAX_SEQ", 0),
+            serve_max_queue=_env_int("BYTEPS_SERVE_MAX_QUEUE", 64),
+            serve_prefill_credits=_env_int(
+                "BYTEPS_SERVE_PREFILL_CREDITS", 0),
+            serve_temperature=_env_float("BYTEPS_SERVE_TEMPERATURE", 0.0),
+            serve_top_k=_env_opt_int("BYTEPS_SERVE_TOP_K"),
+            serve_top_p=_env_opt_float("BYTEPS_SERVE_TOP_P"),
+            serve_eos_id=_env_opt_int("BYTEPS_SERVE_EOS_ID"),
+            serve_model=_env_str("BYTEPS_SERVE_MODEL", ""),
+            serve_checkpoint=_env_str("BYTEPS_SERVE_CHECKPOINT", ""),
             wire_dtype=_env_str("BYTEPS_WIRE_DTYPE", ""),
             mesh_shape=_env_str("BYTEPS_MESH_SHAPE", ""),
         )

@@ -111,6 +111,14 @@ class ScheduledQueue:
             return task
         return None
 
+    def drain(self) -> List[TensorTaskEntry]:
+        """Remove and return every queued task, ignoring readiness and
+        credits (no credit accounting happens — callers use this to
+        fail/abandon a queue wholesale, not to execute the tasks)."""
+        with self._cv:
+            tasks, self._queue = list(self._queue), []
+            return tasks
+
     def report_finish(self, task: TensorTaskEntry) -> None:
         """Return credits (reference scheduled_queue.cc:168-174)."""
         with self._cv:

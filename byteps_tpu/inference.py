@@ -313,6 +313,86 @@ def make_generate_fn(model: Transformer, max_new_tokens: int, *,
     return _layout_aware_jit(run)
 
 
+class _AutoLayoutCache:
+    """LRU bookkeeping for AUTO-layout compiled executables and their
+    placed parameter trees (the machinery behind ``_layout_aware_jit``).
+
+    Two nested LRUs: a long-lived serving process cycling prompt shapes
+    (or alternating distinct same-shape int8 trees) must not pin
+    compiled executables and full placed parameter copies forever (r4
+    advisor).
+
+      * ``max_compiled`` compiled executables, keyed on tree structure +
+        every leaf's (shape, dtype) + the prompt shape;
+      * per executable, ``max_placed`` placed (device_put into the
+        compiler-chosen layout) copies of the full parameter tree, keyed
+        on EVERY leaf's identity — a tree sharing just its first leaf
+        with a previously placed one must not reuse it, and the leaves
+        are held in the entry so no id can be recycled.
+
+    ``compile_fn(variables, prompt, rng) -> (compiled, input_formats)``
+    and ``place_fn(tree_or_args, format)`` are injectable so the LRU
+    semantics are unit-testable on CPU (tests/test_inference_jit_cache.
+    py) — the real compile path is only reachable on TPU.
+    """
+
+    def __init__(self, compile_fn, place_fn, max_compiled: int = 8,
+                 max_placed: int = 2):
+        from collections import OrderedDict
+
+        self._odict = OrderedDict
+        self.cache: "OrderedDict" = OrderedDict()
+        self.max_compiled = max_compiled
+        self.max_placed = max_placed
+        self.compile_fn = compile_fn
+        self.place_fn = place_fn
+
+    @staticmethod
+    def key_of(variables, prompt, rng, leaves=None):
+        if leaves is None:
+            leaves = jax.tree_util.tree_leaves(variables)
+        return (jax.tree_util.tree_structure((variables, prompt, rng)),
+                tuple((x.shape, str(x.dtype)) for x in leaves),
+                prompt.shape, str(prompt.dtype))
+
+    def __call__(self, variables, prompt, rng, leaves=None):
+        # one tree walk per call: the caller's leaves list (computed for
+        # its int8 gate) feeds the compile key and the placed-copy
+        # identity key alike
+        if leaves is None:
+            leaves = jax.tree_util.tree_leaves(variables)
+        key = self.key_of(variables, prompt, rng, leaves)
+        ent = self.cache.get(key)
+        if ent is None:
+            compiled, formats = self.compile_fn(variables, prompt, rng)
+            self.cache[key] = ent = (compiled, formats, self._odict())
+            if len(self.cache) > self.max_compiled:
+                self.cache.popitem(last=False)
+        else:
+            self.cache.move_to_end(key)
+        compiled, formats, placed = ent
+        # re-lay the params once per distinct tree (identity-keyed); a
+        # couple of placed copies may be alive at once (alternating
+        # trees, e.g. an A/B) without re-device_putting per call
+        pkey = tuple(id(x) for x in leaves)
+        hit = placed.get(pkey)
+        if hit is None:
+            # evict BEFORE placing so at most max_placed full device
+            # copies of the params are ever alive (placing first would
+            # transiently hold one extra — an OOM hazard for trees near
+            # half of HBM; holding 2 is the explicit trade for not
+            # re-device_putting per call when two trees alternate)
+            while len(placed) >= self.max_placed:
+                placed.popitem(last=False)
+            placed[pkey] = hit = (
+                list(leaves), self.place_fn(variables, formats[0]))
+        else:
+            placed.move_to_end(pkey)
+        pvars = hit[1]
+        p, r = self.place_fn((prompt, rng), (formats[1], formats[2]))
+        return compiled(pvars, p, r)
+
+
 def _layout_aware_jit(run):
     """jit ``run(variables, prompt, rng)``; int8 trees on TPU compile
     with AUTO input layouts.
@@ -324,6 +404,8 @@ def _layout_aware_jit(run):
     are ``device_put`` into the chosen layout on first use (a no-op copy
     on subsequent calls, since the placed tree is returned to the cache).
     Float trees see no effect from AUTO and take the plain jit path.
+    LRU bookkeeping lives in ``_AutoLayoutCache`` (exposed as
+    ``call._cache`` for introspection).
     """
     plain = jax.jit(run)
     try:
@@ -331,15 +413,12 @@ def _layout_aware_jit(run):
         auto_jit = jax.jit(run, in_shardings=Format(Layout.AUTO))
     except Exception:  # pragma: no cover - older jax
         return plain
-    from collections import OrderedDict
 
-    # both caches are LRU-bounded: a long-lived serving process cycling
-    # prompt shapes (or alternating distinct same-shape int8 trees) must
-    # not pin compiled executables and full placed parameter copies
-    # forever (r4 advisor)
-    cache: OrderedDict = OrderedDict()
-    _MAX_COMPILED = 8
-    _MAX_PLACED = 2
+    def compile_fn(variables, prompt, rng):
+        compiled = auto_jit.lower(variables, prompt, rng).compile()
+        return compiled, compiled.input_formats[0]
+
+    cache = _AutoLayoutCache(compile_fn, jax.device_put)
 
     def call(variables, prompt, rng):
         leaves = jax.tree_util.tree_leaves(variables)
@@ -347,43 +426,9 @@ def _layout_aware_jit(run):
                        for x in leaves)
         if not has_int8 or jax.default_backend() not in ("tpu", "axon"):
             return plain(variables, prompt, rng)
-        key = (jax.tree_util.tree_structure((variables, prompt, rng)),
-               tuple((x.shape, str(x.dtype)) for x in leaves),
-               prompt.shape, str(prompt.dtype))
-        ent = cache.get(key)
-        if ent is None:
-            compiled = auto_jit.lower(variables, prompt, rng).compile()
-            cache[key] = ent = (compiled, compiled.input_formats[0],
-                                OrderedDict())
-            if len(cache) > _MAX_COMPILED:
-                cache.popitem(last=False)
-        else:
-            cache.move_to_end(key)
-        compiled, formats, placed = ent
-        # re-lay the params once per distinct tree — keyed on EVERY
-        # leaf's identity (a tree sharing just its first leaf with a
-        # previously placed one must not reuse it); the leaves are held
-        # in the cache entry so no id can be recycled.  A couple of
-        # placed copies may be alive at once (alternating trees, e.g.
-        # an A/B) without re-device_putting the full params per call.
-        pkey = tuple(id(x) for x in leaves)
-        hit = placed.get(pkey)
-        if hit is None:
-            # evict BEFORE placing so at most _MAX_PLACED full device
-            # copies of the params are ever alive (placing first would
-            # transiently hold one extra — an OOM hazard for trees near
-            # half of HBM; holding 2 is the explicit trade for not
-            # re-device_putting per call when two trees alternate)
-            while len(placed) >= _MAX_PLACED:
-                placed.popitem(last=False)
-            placed[pkey] = hit = (
-                list(leaves), jax.device_put(variables, formats[0]))
-        else:
-            placed.move_to_end(pkey)
-        pvars = hit[1]
-        p, r = jax.device_put((prompt, rng), (formats[1], formats[2]))
-        return compiled(pvars, p, r)
+        return cache(variables, prompt, rng, leaves)
 
+    call._cache = cache
     return call
 
 

@@ -15,7 +15,11 @@ The same env names keep working so reference run scripts port directly:
   DMLC_ROLE                             -> "worker" runs the command;
                                            "server" + BYTEPS_ENABLE_ASYNC=1
                                            runs a TCP PS shard
-                                           (engine/ps_server.py); otherwise
+                                           (engine/ps_server.py);
+                                           "serve" runs the continuous-
+                                           batching inference frontend
+                                           (serving/frontend.py, knobs
+                                           BYTEPS_SERVE_*); otherwise
                                            server/scheduler exit 0 with a
                                            notice (sync mode needs no tier)
   BYTEPS_ENABLE_GDB=1                   -> wrap the command in gdb
@@ -125,6 +129,13 @@ def main(argv=None) -> int:
             "replace the parameter-server tier. Exiting."
         )
         return 0
+    if role == "serve":
+        # continuous-batching inference tier (byteps_tpu/serving/):
+        # build the engine from BYTEPS_SERVE_* and block on the TCP
+        # frontend — the inference analog of the async-PS server role
+        from .serving.frontend import serve_from_env
+
+        return serve_from_env(env)
     if role == "scheduler":
         # obsolete: JAX's coordination service (jax.distributed) replaces
         # the DMLC scheduler rendezvous

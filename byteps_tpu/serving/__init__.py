@@ -1,0 +1,40 @@
+"""Continuous-batching serving engine (Orca/vLLM-style, JAX-native).
+
+The inference layer (``byteps_tpu.inference``) stops at one-shot
+``generate()`` calls: every caller pays a private prefill + decode loop,
+and concurrent callers never share a batch.  This package turns those
+kernels into a *serving engine*:
+
+  * ``slots`` — a fixed-capacity KV-cache slot pool built on
+    ``models.transformer.init_cache`` (N slots x max_seq padded cache),
+    so admitting a request is a cache-row write, not a recompile;
+  * ``scheduler`` — credit-scheduled admission reusing the semantics of
+    ``common/scheduler.py:ScheduledQueue``: prefill (large, bursty)
+    interleaves against decode (small, latency-critical) under a token
+    credit budget, FIFO within priority, with a bounded queue that
+    rejects loudly when full;
+  * ``engine`` — the jitted step functions (batched single-token decode
+    over the whole slot pool; bucket-padded prefill) plus the host-side
+    tick loop; static shapes end to end, so steady-state serving never
+    retraces;
+  * ``frontend`` — an in-process ``ServeClient`` (submit / stream /
+    cancel / drain) and a thin length-prefixed TCP frontend launched by
+    ``launcher.py`` under the ``serve`` role;
+  * ``metrics`` — TTFT/TPOT/queue-wait and occupancy/tokens-per-sec
+    counters exported through the process ``Tracer``.
+
+Correctness anchor: in deterministic mode (the default) the engine's
+output is token-identical to sequential ``generate()`` per request —
+see docs/serving.md.
+"""
+
+from .engine import Request, RequestState, ServingEngine  # noqa: F401
+from .frontend import ServeClient, serve, serve_from_env  # noqa: F401
+from .metrics import ServeMetrics, get_serve_metrics  # noqa: F401
+from .scheduler import (  # noqa: F401
+    AdmissionError,
+    PrefillTask,
+    QueueFullError,
+    ServeScheduler,
+)
+from .slots import SlotPool  # noqa: F401

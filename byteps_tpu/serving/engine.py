@@ -1,0 +1,597 @@
+"""Continuous-batching engine: jitted slot-pool step functions + tick loop.
+
+Two compiled programs serve steady state, regardless of how many
+requests flow through:
+
+  * **decode step** — one token for EVERY slot per tick: the model's
+    per-row ``Transformer.decode`` is ``vmap``-ed over the slot axis
+    with per-slot position scalars (slots sit at different depths), so
+    the whole pool advances in one program with static ``[N_slots]``
+    token/pos vectors and an active-slot mask.  Inactive slots compute
+    garbage into their (freed) rows — the price of static shapes — and
+    their sampled tokens are masked to ``pad_id``.
+  * **prefill** — one request's padded prompt into its slot row:
+    ``dynamic_slice`` the row out, run the model's cached prefill
+    (static ``pos=0`` — the same dense-prefill path ``generate()``
+    takes), gather the true last position's logits, ``dynamic_update_
+    slice`` the row back.  Prompts are right-padded to power-of-two
+    buckets so the compile count is O(log max_seq), not O(#lengths).
+
+**Determinism / parity contract** (the correctness anchor, pinned by
+tests/test_serving.py and scripts/serve_smoke.py): per request, the
+engine reproduces sequential ``generate()`` token for token — greedy
+trivially, and under sampling by replaying ``generate()``'s exact key
+chain (``PRNGKey(seed)``; split once at prefill, once per decode step).
+The numerics match because (a) every per-slot computation is
+row-independent under ``vmap``, and (b) a longer cache than
+``generate()``'s only adds *masked* attention slots, whose
+``exp(-1e30 - max)`` scores underflow to exactly 0.0 and contribute
+nothing to any softmax sum or PV dot.  Batch composition therefore
+cannot leak between requests.
+
+Tick order is fixed: cancellations, then credit-bounded admissions (in
+scheduler grant order), then one decode pass over the pool (slot
+order), then credits return.  Given an admission order, the engine's
+entire output is deterministic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import queue
+import threading
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common import logging as bps_log
+from ..inference import sample_logits
+from ..models.transformer import Transformer
+from . import metrics as sm
+from .metrics import ServeMetrics, get_serve_metrics
+from .scheduler import ServeScheduler
+from .slots import SlotPool
+
+__all__ = ["Request", "RequestState", "ServingEngine"]
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"
+    ACTIVE = "active"
+    DONE = "done"
+    CANCELLED = "cancelled"
+    FAILED = "failed"  # engine tick raised; see Request.error
+
+
+_END = object()  # stream sentinel
+
+
+@dataclasses.dataclass
+class Request:
+    """One in-flight generation request.  Stream tokens with ``for tok
+    in req:`` (blocks until the engine emits them) or block for the
+    whole sequence with ``result()``."""
+
+    id: int
+    prompt: np.ndarray          # [T] int32
+    max_new_tokens: int
+    seed: int = 0
+    priority: int = 0
+    state: RequestState = RequestState.QUEUED
+    cancelled: bool = False
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    slot: Optional[int] = None
+    t_submit: float = 0.0
+    t_admit: float = 0.0
+    t_first: float = 0.0
+    t_last: float = 0.0
+    error: Optional[BaseException] = None
+    _out: "queue.Queue" = dataclasses.field(default_factory=queue.Queue)
+    _done: threading.Event = dataclasses.field(
+        default_factory=threading.Event)
+
+    def __iter__(self):
+        while True:
+            item = self._out.get()
+            if item is _END:
+                # an engine failure must not masquerade as a clean,
+                # short completion to streaming consumers
+                if self.error is not None:
+                    raise RuntimeError(
+                        f"serving engine failed while request {self.id} "
+                        f"was in flight: {self.error!r}") from self.error
+                return
+            yield item
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Block until the request finishes; returns the emitted tokens
+        (CANCELLED requests return whatever was emitted before).
+        Raises if the engine failed while this request was in flight."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.id} not done within {timeout}s")
+        if self.error is not None:
+            raise RuntimeError(
+                f"serving engine failed while request {self.id} was in "
+                f"flight: {self.error!r}") from self.error
+        return np.asarray(self.tokens, np.int32)
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+
+def _prefill_forward(mdl: Transformer, tokens, caches, true_len):
+    """Padded-prompt prefill returning the logits at ``true_len - 1``.
+
+    Structurally identical to ``Transformer.decode(..., last_only=True)``
+    — embed, blocks at static ``pos=0``, slice ONE position, ``ln_f``,
+    head — except the slice lands on the true last prompt token instead
+    of the literal last row, so right-padding never reaches the LM head.
+    Pad K/V beyond ``true_len`` does enter the cache, but decode's
+    causal mask admits position ``p`` only once the request's own write
+    cursor passes it — by which point the pad row has been overwritten
+    by a real token's K/V (see docs/serving.md).
+    """
+    cfg = mdl.cfg
+    x = mdl.embed(tokens)
+    if cfg.pos_emb == "learned":
+        x = x + mdl.pos(jnp.arange(tokens.shape[1])[None, :])
+    new_caches = []
+    for block, c in zip(mdl.blocks, caches):
+        x, nc = block(x, cache=c, pos=0)
+        new_caches.append(nc)
+    x = jax.lax.dynamic_slice_in_dim(x, true_len - 1, 1, axis=1)
+    return mdl.logits(mdl.ln_f(x)), tuple(new_caches)
+
+
+def _next_bucket(n: int, lo: int, hi: int) -> int:
+    """Smallest power-of-two >= n, floored at lo, clamped to hi."""
+    b = lo
+    while b < n:
+        b *= 2
+    return min(b, hi)
+
+
+class ServingEngine:
+    """Continuous-batching serving over a ``SlotPool``.
+
+    Sampling parameters (``temperature``/``top_k``/``top_p``) are fixed
+    per engine — they are *static* arguments of the compiled step
+    functions, which is what makes steady-state serving retrace-free.
+    Per-request variation rides the ``seed`` (and greedy engines ignore
+    it).  ``eos_id`` stops a request early; every request also carries
+    its own ``max_new_tokens`` budget.
+
+    Drive it either by calling :meth:`step` yourself (tests, fully
+    deterministic single-threaded use) or via :meth:`start`'s background
+    tick thread (the frontend's mode).
+    """
+
+    def __init__(self, model: Transformer, variables, *,
+                 n_slots: int = 8, max_seq: Optional[int] = None,
+                 temperature: float = 0.0, top_k: Optional[int] = None,
+                 top_p: Optional[float] = None,
+                 eos_id: Optional[int] = None, pad_id: int = 0,
+                 kv_quant: bool = False, cache_layout: str = "grouped",
+                 max_queue: int = 64,
+                 prefill_credits: Optional[int] = None,
+                 min_prefill_bucket: int = 8,
+                 metrics: Optional[ServeMetrics] = None):
+        self.model = model
+        self.variables = variables
+        cfg = model.cfg
+        self.max_seq = max_seq if max_seq is not None else cfg.max_seq_len
+        self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
+        self.eos_id = eos_id
+        self.pad_id = pad_id
+        self.greedy = temperature == 0
+        self.min_prefill_bucket = max(1, min_prefill_bucket)
+        self.pool = SlotPool(cfg, n_slots, self.max_seq,
+                             kv_quant=kv_quant, layout=cache_layout)
+        # credit budget in padded prefill tokens per tick; default = one
+        # max-length prefill, i.e. "a tick admits at most one worst-case
+        # prompt's worth of prefill work" — decode latency stays bounded
+        # while short prompts can still batch several admissions per tick
+        budget = (prefill_credits if prefill_credits and prefill_credits > 0
+                  else self.max_seq)
+        self.scheduler = ServeScheduler(
+            max_queue=max_queue, credit_budget=budget)
+        self.metrics = metrics if metrics is not None else get_serve_metrics()
+
+        self._lock = threading.RLock()
+        self._req_seq = 0
+        self._slot_req: List[Optional[Request]] = [None] * n_slots
+        self._tok = jnp.zeros((n_slots,), jnp.int32)
+        self._keys = jnp.zeros((n_slots, 2), jnp.uint32)
+        self._outstanding = 0
+        self._drain_cv = threading.Condition(self._lock)
+        self._wake = threading.Condition()
+        self._thread: Optional[threading.Thread] = None
+        self._stop_flag = False
+        self._engine_error: Optional[BaseException] = None
+        # trace-time counters: the Python body of a jitted fn runs only
+        # when jax (re)traces, so these count compilations portably —
+        # steady-state stability is asserted on them
+        self.decode_traces = 0
+        self.prefill_traces = 0
+        # donate the cache pool into each step: the pool is replaced by
+        # the step's output, and without donation XLA would copy every
+        # layer's full [N, S, ...] cache per tick just to write one row
+        self._decode_step = jax.jit(self._make_decode_fn(),
+                                    donate_argnums=(1,))
+        self._prefill_fns: Dict[int, object] = {}
+
+    # ---------------------------------------------------- jitted programs
+
+    def _make_decode_fn(self):
+        model, greedy = self.model, self.greedy
+        temperature, top_k, top_p = self.temperature, self.top_k, self.top_p
+        pad_id = self.pad_id
+
+        def one(variables, row, tok, pos, key):
+            rowb = jax.tree_util.tree_map(lambda c: c[None], row)
+            logits, new = model.apply(
+                variables, tok[None, None], rowb, pos,
+                method=Transformer.decode)
+            if greedy:
+                nxt = jnp.argmax(logits[0, -1], axis=-1).astype(jnp.int32)
+                nk = key
+            else:
+                # generate()'s exact per-step key chain: carry split[0],
+                # sample with split[1]
+                nk, sub = jax.random.split(key)
+                nxt = sample_logits(logits[:, -1], sub, temperature,
+                                    top_k, top_p)[0].astype(jnp.int32)
+            return jax.tree_util.tree_map(lambda c: c[0], new), nxt, nk
+
+        def decode_fn(variables, caches, tok, pos, active, keys):
+            self.decode_traces += 1  # trace-time only
+            caches, nxt, keys2 = jax.vmap(
+                one, in_axes=(None, 0, 0, 0, 0))(
+                    variables, caches, tok, pos, keys)
+            nxt = jnp.where(active, nxt, pad_id)
+            if not greedy:
+                keys2 = jnp.where(active[:, None], keys2, keys)
+            else:
+                keys2 = keys
+            return caches, nxt, keys2
+
+        return decode_fn
+
+    def _prefill_fn(self, bucket: int):
+        fn = self._prefill_fns.get(bucket)
+        if fn is not None:
+            return fn
+        model, greedy = self.model, self.greedy
+        temperature, top_k, top_p = self.temperature, self.top_k, self.top_p
+
+        def prefill_fn(variables, caches, prompt, slot, true_len, key):
+            self.prefill_traces += 1  # trace-time only
+            row = jax.tree_util.tree_map(
+                lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=0),
+                caches)
+            logits, new_row = model.apply(
+                variables, prompt, row, true_len, method=_prefill_forward)
+            if greedy:
+                tok0 = jnp.argmax(logits[0, -1], axis=-1).astype(jnp.int32)
+                nk = key
+            else:
+                nk, sub = jax.random.split(key)
+                tok0 = sample_logits(logits[:, -1], sub, temperature,
+                                     top_k, top_p)[0].astype(jnp.int32)
+            caches = jax.tree_util.tree_map(
+                lambda c, r: jax.lax.dynamic_update_slice_in_dim(
+                    c, r, slot, axis=0),
+                caches, new_row)
+            return caches, tok0, nk
+
+        fn = jax.jit(prefill_fn, donate_argnums=(1,))
+        self._prefill_fns[bucket] = fn
+        return fn
+
+    # ------------------------------------------------------------- submit
+
+    def submit(self, prompt, max_new_tokens: int, *, seed: int = 0,
+               priority: int = 0) -> Request:
+        """Enqueue a generation request.  Raises ``ValueError`` on an
+        infeasible request and ``QueueFullError`` (typed backpressure)
+        when the bounded admission queue is at capacity."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        T = int(prompt.shape[0])
+        if T < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got "
+                             f"{max_new_tokens}")
+        if T + max_new_tokens > self.max_seq:
+            raise ValueError(
+                f"prompt ({T}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds engine max_seq {self.max_seq}")
+        bucket = _next_bucket(T, self.min_prefill_bucket, self.max_seq)
+        # dead-engine check AND enqueue under the engine lock, which
+        # _fail_all holds while draining: a submit racing the failure
+        # path must either land before the drain (and be failed by it)
+        # or see the error — never enqueue into a dead engine's queue.
+        # The outstanding counter also increments here, BEFORE the tick
+        # thread can see the request: a fast request could otherwise
+        # finish (decrementing) first, and a concurrent drain() would
+        # see a transiently-zero counter with work still in flight.
+        with self._lock:
+            if self._engine_error is not None:
+                raise RuntimeError(
+                    f"serving engine is dead (tick failed with "
+                    f"{self._engine_error!r}); restart it") \
+                    from self._engine_error
+            self._req_seq += 1
+            req = Request(id=self._req_seq, prompt=prompt,
+                          max_new_tokens=max_new_tokens, seed=seed,
+                          priority=priority, t_submit=time.monotonic())
+            self._outstanding += 1
+            try:
+                self.scheduler.submit(req, bucket)
+            except Exception:
+                self._outstanding -= 1
+                self._drain_cv.notify_all()  # same lock; wake waiters
+                self.metrics.bump(sm.REJECTED)
+                raise
+        self.metrics.bump(sm.SUBMITTED)
+        with self._wake:
+            self._wake.notify_all()
+        return req
+
+    def cancel(self, req: Request) -> None:
+        """Request cancellation; the engine retires the request on its
+        next tick (queued requests are dropped at grant time)."""
+        req.cancelled = True
+        with self._wake:
+            self._wake.notify_all()
+
+    # --------------------------------------------------------------- tick
+
+    def step(self) -> Dict[str, int]:
+        """One engine tick: cancellations -> credit-bounded admissions ->
+        one batched decode pass -> credits return.  Returns tick stats."""
+        with self._lock:
+            return self._step_locked()
+
+    def _step_locked(self) -> Dict[str, int]:
+        emitted = 0
+        granted: List = []
+        try:
+            # 0. retire cancelled active requests (frees their slots
+            # for this tick's admissions)
+            for slot in self.pool.active_slots():
+                req = self._slot_req[slot]
+                if req is not None and req.cancelled:
+                    self._finish(req, RequestState.CANCELLED)
+            # 1. admissions, in scheduler grant order (priority desc,
+            # FIFO)
+            free = self.pool.free_count
+            if free:
+                granted = self.scheduler.admit(free)
+                for task in granted:
+                    if task.request.cancelled:
+                        self._finish(task.request, RequestState.CANCELLED)
+                    else:
+                        emitted += self._admit(task.request)
+            # 2. one decode pass over the pool
+            active = self.pool.active_slots()
+            if active:
+                emitted += self._decode_tick(active)
+        except Exception as e:
+            # granted tasks are already popped from the queue: a
+            # request whose _admit never ran (or raised before its slot
+            # assignment) is invisible to both the queue drain and the
+            # active-slot scan in _fail_all — fail it here or its
+            # result()/drain() callers hang forever
+            for task in granted:
+                req = task.request
+                if req.state is RequestState.QUEUED:
+                    req.error = e
+                    self._finish(req, RequestState.FAILED)
+            raise
+        finally:
+            # 3. credits back — in normal ticks AFTER decode, so the
+            # budget truly bounds the prefill work interleaved between
+            # consecutive decode passes; on a failed tick, so the
+            # credits of granted work are never leaked
+            for task in granted:
+                self.scheduler.finish(task)
+        # idle ticks (background poll with nothing in flight) emit no
+        # gauges — a traced long-lived server would otherwise append
+        # two counter events per 50ms poll to the Tracer's in-memory
+        # list forever
+        if granted or emitted or self.pool.active_count \
+                or self.scheduler.depth:
+            self.metrics.observe_tick(self.pool.occupancy(),
+                                      self.scheduler.depth, emitted)
+        return {"admitted": len(granted), "emitted": emitted,
+                "active": self.pool.active_count,
+                "queued": self.scheduler.depth}
+
+    def _admit(self, req: Request) -> int:
+        T = int(req.prompt.shape[0])
+        slot = self.pool.assign(req.id, T)
+        assert slot is not None, "admit() granted beyond free slots"
+        req.slot = slot
+        req.state = RequestState.ACTIVE
+        req.t_admit = time.monotonic()
+        self._slot_req[slot] = req
+        bucket = _next_bucket(T, self.min_prefill_bucket, self.max_seq)
+        padded = np.full((1, bucket), self.pad_id, np.int32)
+        padded[0, :T] = req.prompt
+        key = (jnp.zeros((2,), jnp.uint32) if self.greedy
+               else jax.random.PRNGKey(req.seed))
+        fn = self._prefill_fn(bucket)
+        caches, tok0, nk = fn(self.variables, self.pool.caches,
+                              jnp.asarray(padded), slot, T, key)
+        self.pool.caches = caches
+        self._tok = self._tok.at[slot].set(tok0)
+        if not self.greedy:
+            self._keys = self._keys.at[slot].set(nk)
+        self.metrics.bump(sm.ADMITTED)
+        self.metrics.bump(sm.PREFILL_TOKENS, bucket)
+        self._emit(req, int(tok0))
+        return 1
+
+    def _decode_tick(self, active: List[int]) -> int:
+        n = self.pool.n_slots
+        pos = np.zeros((n,), np.int32)
+        mask = np.zeros((n,), bool)
+        for slot in active:
+            pos[slot] = self.pool.pos[slot]
+            mask[slot] = True
+        caches, nxt, keys = self._decode_step(
+            self.variables, self.pool.caches, self._tok,
+            jnp.asarray(pos), jnp.asarray(mask), self._keys)
+        self.pool.caches = caches
+        self._tok = nxt
+        self._keys = keys
+        nxt_host = np.asarray(nxt)
+        emitted = 0
+        for slot in active:
+            req = self._slot_req[slot]
+            self.pool.advance(slot)
+            self._emit(req, int(nxt_host[slot]))
+            emitted += 1
+        return emitted
+
+    def _emit(self, req: Request, tok: int) -> None:
+        now = time.monotonic()
+        if not req.tokens:
+            req.t_first = now
+        req.t_last = now
+        req.tokens.append(tok)
+        req._out.put(tok)
+        done = (len(req.tokens) >= req.max_new_tokens
+                or (self.eos_id is not None and tok == self.eos_id))
+        if done:
+            self._finish(req, RequestState.DONE)
+
+    def _finish(self, req: Request, state: RequestState) -> None:
+        req.state = state
+        if req.slot is not None:
+            self._slot_req[req.slot] = None
+            self.pool.free(req.slot)
+            req.slot = None
+        req._out.put(_END)
+        req._done.set()
+        if state is RequestState.DONE:
+            n = len(req.tokens)
+            tpot = ((req.t_last - req.t_first) / (n - 1) if n > 1 else None)
+            self.metrics.observe_request(
+                queue_wait_s=req.t_admit - req.t_submit,
+                ttft_s=req.t_first - req.t_submit, tpot_s=tpot, tokens=n)
+        elif state is RequestState.FAILED:
+            self.metrics.bump(sm.FAILED)
+        else:
+            self.metrics.bump(sm.CANCELLED)
+        with self._drain_cv:
+            self._outstanding -= 1
+            self._drain_cv.notify_all()
+
+    # ---------------------------------------------------------- lifecycle
+
+    def _idle(self) -> bool:
+        return self.pool.active_count == 0 and self.scheduler.depth == 0
+
+    def start(self) -> "ServingEngine":
+        """Run the tick loop on a background thread (frontend mode)."""
+        with self._lock:
+            if self._thread is not None:
+                return self
+            self._stop_flag = False
+            self._thread = threading.Thread(
+                target=self._run, name="byteps-serve-engine", daemon=True)
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop_flag:
+            try:
+                self.step()
+            except Exception as e:
+                # a dead tick thread must not look like a hung one:
+                # fail every in-flight and queued request loudly and
+                # refuse new submissions — blocked result()/drain()
+                # callers get the error instead of waiting forever
+                bps_log.warning("serving engine tick failed: %r", e)
+                self._fail_all(e)
+                return
+            with self._wake:
+                if self._idle() and not self._stop_flag:
+                    self._wake.wait(timeout=0.05)
+
+    def _fail_all(self, exc: BaseException) -> None:
+        with self._lock:
+            self._engine_error = exc
+            for slot in self.pool.active_slots():
+                req = self._slot_req[slot]
+                if req is not None:
+                    req.error = exc
+                    self._finish(req, RequestState.FAILED)
+            # credit-FREE drain: admit() would skip queued tasks larger
+            # than whatever credits the failed tick left, hanging their
+            # result() callers forever
+            for task in self.scheduler.drain_pending():
+                task.request.error = exc
+                self._finish(task.request, RequestState.FAILED)
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop_flag = True
+        with self._wake:
+            self._wake.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+            if t.is_alive():
+                # a wedged tick (e.g. a long compile) must not be
+                # abandoned: clearing _thread would let a later start()
+                # reset _stop_flag and spawn a SECOND tick loop beside
+                # this one — leave it tracked, not restartable
+                bps_log.warning(
+                    "serving engine tick thread still running after "
+                    "%.1fs; engine not restartable until it exits",
+                    timeout)
+            else:
+                self._thread = None
+
+    def drain(self, timeout: Optional[float] = None) -> None:
+        """Block until every submitted request has finished.  Without a
+        background thread, drives :meth:`step` inline (deterministic
+        single-threaded mode)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        if self._thread is None:
+            while True:
+                with self._lock:
+                    if self._outstanding == 0:
+                        return
+                self.step()
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError("drain timed out")
+        else:
+            with self._drain_cv:
+                while self._outstanding > 0:
+                    if (deadline is not None
+                            and time.monotonic() >= deadline):
+                        raise TimeoutError("drain timed out")
+                    remaining = (None if deadline is None
+                                 else deadline - time.monotonic())
+                    self._drain_cv.wait(remaining)
+
+    # --------------------------------------------------------- inspection
+
+    def compile_counts(self) -> Dict[str, int]:
+        """Trace counts of the step programs — steady-state serving must
+        keep ``decode`` at 1 and ``prefill`` at the number of distinct
+        buckets touched (asserted by tests and bench_serve.py)."""
+        return {"decode": self.decode_traces,
+                "prefill": self.prefill_traces,
+                "prefill_buckets": len(self._prefill_fns)}

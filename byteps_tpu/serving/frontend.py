@@ -1,0 +1,277 @@
+"""Serving frontends: in-process ``ServeClient`` and a thin TCP server.
+
+The TCP layer reuses the length-prefixed wire helpers of
+``engine/ps_server.py`` (``_encode``/``_decode`` — the same u8-op,
+raw-numpy-payload framing the PS tier speaks), so a serve process slots
+into the launcher the way a PS shard does: ``DMLC_ROLE=serve`` runs
+:func:`serve_from_env`.
+
+Wire ops (request := the ps_server frame; one request per round trip):
+
+    0 = SUBMIT  name = JSON {"max_new_tokens", "seed", "priority"}
+                arr  = int32 prompt tokens [T]
+                reply: status=0, name = request id, arr = int32 tokens;
+                rejections (queue full, infeasible request) come back
+                as status=1 with the typed error's message — the
+                connection survives, clients can back off and retry.
+    1 = STATS   reply payload = JSON engine metrics summary
+    2 = PING    liveness
+
+SUBMIT blocks the *connection* until the request finishes — per-request
+streaming stays in-process (``Request.__iter__``); concurrency across
+the wire comes from concurrent connections, which the engine batches
+into one decode pool (that is the whole point of continuous batching).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+from ..common import logging as bps_log
+from ..engine.ps_server import _decode, _encode
+from .engine import Request, ServingEngine
+from .scheduler import AdmissionError
+
+OP_SUBMIT, OP_STATS, OP_PING = range(3)
+
+__all__ = ["ServeClient", "ServeFrontend", "RemoteServeClient", "serve",
+           "serve_from_env", "OP_SUBMIT", "OP_STATS", "OP_PING"]
+
+
+class ServeClient:
+    """In-process client: submit -> stream tokens, cancel, drain.
+
+    A thin convenience veneer over :class:`ServingEngine` that starts
+    the background tick thread on first use and owns its shutdown."""
+
+    def __init__(self, engine: ServingEngine):
+        self.engine = engine
+
+    def submit(self, prompt, max_new_tokens: int, *, seed: int = 0,
+               priority: int = 0) -> Request:
+        self.engine.start()
+        return self.engine.submit(prompt, max_new_tokens, seed=seed,
+                                  priority=priority)
+
+    def stream(self, prompt, max_new_tokens: int, *, seed: int = 0,
+               priority: int = 0):
+        """Iterator of tokens as the engine emits them."""
+        return iter(self.submit(prompt, max_new_tokens, seed=seed,
+                                priority=priority))
+
+    def generate(self, prompt, max_new_tokens: int, *, seed: int = 0,
+                 priority: int = 0,
+                 timeout: Optional[float] = None) -> np.ndarray:
+        """Blocking submit -> full token array."""
+        return self.submit(prompt, max_new_tokens, seed=seed,
+                           priority=priority).result(timeout)
+
+    def cancel(self, req: Request) -> None:
+        self.engine.cancel(req)
+
+    def drain(self, timeout: Optional[float] = None) -> None:
+        self.engine.drain(timeout)
+
+    def close(self) -> None:
+        self.engine.stop()
+
+
+# ------------------------------------------------------------------ TCP tier
+
+
+class _ServeHandler(socketserver.BaseRequestHandler):
+    def handle(self):  # one connection, many requests
+        engine: ServingEngine = self.server.engine  # type: ignore
+        sock = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            while True:
+                try:
+                    op, name, arr, _ = _decode(sock)
+                except (ConnectionError, OSError):
+                    return
+                try:
+                    if op == OP_SUBMIT:
+                        params = json.loads(name) if name else {}
+                        req = engine.submit(
+                            np.asarray(arr, np.int32).reshape(-1),
+                            int(params.get("max_new_tokens", 16)),
+                            seed=int(params.get("seed", 0)),
+                            priority=int(params.get("priority", 0)))
+                        toks = req.result(
+                            timeout=float(params.get("timeout", 300.0)))
+                        reply = _encode(0, str(req.id), toks)
+                    elif op == OP_STATS:
+                        payload = json.dumps(
+                            {**engine.metrics.summary(),
+                             "compile_counts": engine.compile_counts(),
+                             "occupancy": engine.pool.occupancy(),
+                             "queue_depth": engine.scheduler.depth})
+                        reply = _encode(0, "", None, payload.encode())
+                    elif op == OP_PING:
+                        reply = _encode(0, "", None)
+                    else:
+                        reply = _encode(1, "", None,
+                                        f"bad op {op}".encode())
+                except AdmissionError as e:
+                    # typed backpressure: status=1 + reason, socket lives
+                    reply = _encode(1, "", None,
+                                    f"{type(e).__name__}: {e}".encode())
+                except Exception as e:
+                    reply = _encode(
+                        1, "", None, f"{type(e).__name__}: {e}".encode())
+                sock.sendall(reply)
+        except Exception as e:  # pragma: no cover - teardown races
+            bps_log.debug("serve handler exit: %s", e)
+
+
+class ServeFrontend(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, addr, engine: ServingEngine):
+        super().__init__(addr, _ServeHandler)
+        self.engine = engine
+        engine.start()
+
+    def server_close(self):
+        self.engine.stop()
+        super().server_close()
+
+
+def serve(engine: ServingEngine, port: int, host: str = "0.0.0.0",
+          in_thread: bool = False):
+    """Run the TCP frontend over ``engine``.  ``in_thread=True`` returns
+    ``(server, thread)`` for tests; otherwise blocks (launcher mode)."""
+    srv = ServeFrontend((host, port), engine)
+    bps_log.info("byteps_tpu serve frontend listening on %s:%d",
+                 host, srv.server_address[1])
+    if in_thread:
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        return srv, t
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:  # pragma: no cover
+        pass
+    finally:
+        srv.server_close()
+
+
+class RemoteServeClient:
+    """Client for the TCP frontend (same framing as ``RemoteStore``)."""
+
+    def __init__(self, addr: str, timeout: float = 300.0):
+        host, port = addr.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+
+    def _rpc(self, op: int, name: str = "", arr=None):
+        with self._lock:
+            self._sock.sendall(_encode(op, name, arr))
+            status, rname, out, payload = _decode(self._sock)
+        if status != 0:
+            raise RuntimeError(f"serve error: {payload.decode()!r}")
+        return rname, out, payload
+
+    def generate(self, prompt, max_new_tokens: int, *, seed: int = 0,
+                 priority: int = 0) -> np.ndarray:
+        params = json.dumps({"max_new_tokens": max_new_tokens,
+                             "seed": seed, "priority": priority})
+        _, out, _ = self._rpc(OP_SUBMIT, params,
+                              np.asarray(prompt, np.int32).reshape(-1))
+        return np.array(out)
+
+    def stats(self) -> dict:
+        _, _, payload = self._rpc(OP_STATS)
+        return json.loads(payload.decode())
+
+    def ping(self) -> bool:
+        try:
+            self._rpc(OP_PING)
+            return True
+        except (OSError, RuntimeError):
+            return False
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+# ------------------------------------------------------------ launcher role
+
+
+def _model_from_env(cfg_str: str):
+    """Build a (model, variables) pair from ``BYTEPS_SERVE_MODEL``: a
+    comma-separated ``k=v`` list over TransformerConfig's integer axes
+    (vocab_size, num_layers, num_heads, d_model, d_ff, max_seq_len) —
+    random-initialized weights unless ``BYTEPS_SERVE_CHECKPOINT`` points
+    at a checkpoint produced by ``training.checkpoint``.  A serving
+    process with random weights is still the real engine — that is what
+    the smoke/bench tooling runs against."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.transformer import Transformer, TransformerConfig
+
+    kw = {}
+    if cfg_str:
+        for pair in cfg_str.split(","):
+            k, _, v = pair.partition("=")
+            kw[k.strip()] = int(v)
+    kw.setdefault("vocab_size", 256)
+    kw.setdefault("num_layers", 2)
+    kw.setdefault("num_heads", 4)
+    kw.setdefault("d_model", 128)
+    kw.setdefault("d_ff", 256)
+    kw.setdefault("max_seq_len", 512)
+    cfg = TransformerConfig(dtype=jnp.float32, **kw)
+    model = Transformer(cfg)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), tokens)
+    return model, variables
+
+
+def serve_from_env(env=None) -> int:
+    """Entry point for the launcher's ``serve`` role: build the engine
+    from ``BYTEPS_SERVE_*`` and block on the TCP frontend.  An explicit
+    ``env`` mapping overrides the process environment for the
+    ``BYTEPS_*``/``DMLC_*`` keys it carries; either way the cached
+    process config is reset first, so knobs set after an earlier
+    ``get_config()`` call are honored."""
+    import os
+
+    from ..common.config import get_config, reset_config
+
+    if env is not None:
+        os.environ.update({k: str(v) for k, v in env.items()
+                           if k.startswith(("BYTEPS_", "DMLC_"))})
+    reset_config()
+    cfg = get_config()
+    model, variables = _model_from_env(cfg.serve_model)
+    if cfg.serve_checkpoint:
+        from ..training.checkpoint import restore_checkpoint
+
+        variables = {"params": restore_checkpoint(
+            cfg.serve_checkpoint, variables["params"], broadcast=False)}
+    engine = ServingEngine(
+        model, variables,
+        n_slots=cfg.serve_slots,
+        max_seq=(cfg.serve_max_seq or model.cfg.max_seq_len),
+        temperature=cfg.serve_temperature,
+        top_k=cfg.serve_top_k, top_p=cfg.serve_top_p,
+        eos_id=cfg.serve_eos_id,
+        max_queue=cfg.serve_max_queue,
+        prefill_credits=cfg.serve_prefill_credits)
+    serve(engine, cfg.serve_port)
+    return 0

@@ -1,0 +1,151 @@
+"""Serving metrics, surfaced through the process ``Tracer``.
+
+Same pattern as ``resilience/counters.py``: every observation bumps a
+named monotonic counter and — when ``BYTEPS_TRACE_PATH`` is set — lands
+on the shared chrome-trace timeline as a counter event (value track) so
+batch occupancy, queue depth, and token throughput render next to the
+engine's push/pull spans in Perfetto.  Per-request latency samples
+(queue wait, TTFT, TPOT) are additionally kept in-process for the
+``summary()`` percentiles the bench and the TCP STATS op report.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from ..common import logging as bps_log
+
+# canonical counter names
+SUBMITTED = "serve.requests_submitted"
+ADMITTED = "serve.requests_admitted"
+REJECTED = "serve.requests_rejected"
+COMPLETED = "serve.requests_completed"
+CANCELLED = "serve.requests_cancelled"
+FAILED = "serve.requests_failed"
+TOKENS = "serve.tokens_generated"
+PREFILL_TOKENS = "serve.prefill_tokens"
+# per-tick value tracks (gauges, not monotonic)
+OCCUPANCY = "serve.batch_occupancy"
+QUEUE_DEPTH = "serve.queue_depth"
+# per-request latency tracks (milliseconds, one point per completion)
+TTFT_MS = "serve.ttft_ms"
+TPOT_MS = "serve.tpot_ms"
+QUEUE_WAIT_MS = "serve.queue_wait_ms"
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile on an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    k = max(0, min(len(sorted_vals) - 1,
+                   int(round(q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[k]
+
+
+class ServeMetrics:
+    """Thread-safe serving counters + latency samples with Tracer
+    surfacing."""
+
+    def __init__(self, tracer=None):
+        self._counts: Dict[str, int] = {}
+        self._queue_wait: List[float] = []
+        self._ttft: List[float] = []
+        self._tpot: List[float] = []
+        self._lock = threading.Lock()
+        self._tracer = tracer
+
+    def _get_tracer(self):
+        if self._tracer is not None:
+            return self._tracer
+        from ..common.tracing import get_tracer
+
+        return get_tracer()
+
+    # ------------------------------------------------------------ counters
+
+    def bump(self, counter: str, n: int = 1, **args) -> int:
+        with self._lock:
+            total = self._counts.get(counter, 0) + n
+            self._counts[counter] = total
+        tracer = self._get_tracer()
+        if tracer.enabled:
+            safe = {("tensor" if k == "name" else k): v
+                    for k, v in args.items()}
+            tracer.instant(counter, "serve", **safe)
+            tracer.counter(counter, total, "serve")
+        bps_log.debug("%s -> %d %s", counter, total, args or "")
+        return total
+
+    def gauge(self, name: str, value: float) -> None:
+        """Non-monotonic value track (occupancy, queue depth)."""
+        tracer = self._get_tracer()
+        if tracer.enabled:
+            tracer.counter(name, value, "serve")
+
+    # --------------------------------------------------------- observations
+
+    def observe_tick(self, occupancy: float, queue_depth: int,
+                     tokens_emitted: int) -> None:
+        if tokens_emitted:
+            self.bump(TOKENS, tokens_emitted)
+        self.gauge(OCCUPANCY, occupancy)
+        self.gauge(QUEUE_DEPTH, queue_depth)
+
+    def observe_request(self, queue_wait_s: float, ttft_s: float,
+                        tpot_s: Optional[float], tokens: int) -> None:
+        """Record one completed request's latency profile.  ``tpot_s``
+        is None for single-token requests (no inter-token gaps)."""
+        with self._lock:
+            self._queue_wait.append(queue_wait_s)
+            self._ttft.append(ttft_s)
+            if tpot_s is not None:
+                self._tpot.append(tpot_s)
+        self.gauge(QUEUE_WAIT_MS, queue_wait_s * 1e3)
+        self.gauge(TTFT_MS, ttft_s * 1e3)
+        if tpot_s is not None:
+            self.gauge(TPOT_MS, tpot_s * 1e3)
+        self.bump(COMPLETED, tokens=tokens)
+
+    # ------------------------------------------------------------ reporting
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+    def summary(self) -> Dict[str, object]:
+        """Counters plus latency percentiles (seconds)."""
+        with self._lock:
+            counts = dict(self._counts)
+            qw = sorted(self._queue_wait)
+            ttft = sorted(self._ttft)
+            tpot = sorted(self._tpot)
+        out: Dict[str, object] = dict(counts)
+        for label, vals in (("queue_wait", qw), ("ttft", ttft),
+                            ("tpot", tpot)):
+            out[f"{label}_p50_s"] = _percentile(vals, 50)
+            out[f"{label}_p99_s"] = _percentile(vals, 99)
+            out[f"{label}_n"] = len(vals)
+        return out
+
+
+_metrics: Optional[ServeMetrics] = None
+_metrics_lock = threading.Lock()
+
+
+def get_serve_metrics() -> ServeMetrics:
+    global _metrics
+    with _metrics_lock:
+        if _metrics is None:
+            _metrics = ServeMetrics()
+        return _metrics
+
+
+def reset_serve_metrics() -> None:
+    global _metrics
+    with _metrics_lock:
+        _metrics = None

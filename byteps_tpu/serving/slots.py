@@ -1,0 +1,131 @@
+"""Fixed-capacity KV-cache slot pool.
+
+JAX's static-shape world cannot grow a batch: the serving engine instead
+pre-allocates ONE cache pytree of ``n_slots`` rows (``init_cache(cfg,
+n_slots, max_seq)``) and treats the batch dimension as a pool of
+*slots*.  Admitting a request assigns a free slot and writes its prompt
+K/V into that row (``engine._prefill``); freeing returns the index.  No
+allocation, no recompilation — the decode step's shapes never change.
+
+Why freed rows are NOT zeroed: the decode attention mask
+(``kidx <= pos + i`` in ``models.transformer._cached_attention``) admits
+only positions at or below the request's own write cursor, and every
+position up to the cursor has been overwritten by this request's prefill
+or decode writes before the mask can reach it.  Stale K/V from a
+previous tenant is therefore never attended — masked scores contribute
+exactly-zero probability mass (``exp(-1e30 - max)`` underflows to 0.0
+in fp32), so reuse is bit-exact, not just approximately safe.  The
+parity tests pin this.
+
+Slot assignment is lowest-free-index (a heap), which makes the engine's
+tick order — and therefore its whole output — deterministic given the
+admission order.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+from typing import List, Optional
+
+from ..models.transformer import TransformerConfig, init_cache
+
+
+class SlotPool:
+    """``n_slots`` KV-cache rows plus per-slot position bookkeeping.
+
+    The cache pytree itself (``self.caches``) is functional state: the
+    engine threads it through the jitted prefill/decode steps and stores
+    the result back.  The pool owns only the host-side bookkeeping
+    (free set, per-slot cursor) — device state and bookkeeping advance
+    together inside ``ServingEngine.step()`` under the engine lock.
+    """
+
+    def __init__(self, cfg: TransformerConfig, n_slots: int, max_seq: int,
+                 *, kv_quant: bool = False, layout: str = "grouped"):
+        if n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+        if max_seq < 2:
+            raise ValueError(f"max_seq must be >= 2, got {max_seq}")
+        self.cfg = cfg
+        self.n_slots = n_slots
+        self.max_seq = max_seq
+        self.kv_quant = kv_quant
+        self.layout = layout
+        # one cache pytree, batch dim = slot index.  The serving pool
+        # defaults to the grouped layout: the decode step vmaps the
+        # model's per-row decode over slots, and the grouped dense path
+        # batches cleanly under vmap on every backend (the flat Pallas
+        # kernel is a TPU-only single-program fast path).
+        self.caches = init_cache(cfg, n_slots, max_seq,
+                                 quantized=kv_quant, layout=layout)
+        self._lock = threading.Lock()
+        self._free: List[int] = list(range(n_slots))
+        heapq.heapify(self._free)
+        # per-slot cursor: absolute position of the next K/V write
+        # (== number of real tokens the slot's row currently holds)
+        self.pos: List[int] = [0] * n_slots
+        self.request_ids: List[Optional[int]] = [None] * n_slots
+
+    # ------------------------------------------------------------ lifecycle
+
+    def assign(self, request_id: int, prompt_len: int) -> Optional[int]:
+        """Claim the lowest free slot for ``request_id``; None when full.
+        ``prompt_len`` seeds the slot's cursor (prefill writes [0, T))."""
+        if prompt_len < 1 or prompt_len >= self.max_seq:
+            raise ValueError(
+                f"prompt_len {prompt_len} not in [1, max_seq={self.max_seq})")
+        with self._lock:
+            if not self._free:
+                return None
+            slot = heapq.heappop(self._free)
+            self.request_ids[slot] = request_id
+            self.pos[slot] = prompt_len
+            return slot
+
+    def free(self, slot: int) -> None:
+        """Return a slot to the pool (cache row left as-is — see module
+        docstring for why stale K/V is safe)."""
+        with self._lock:
+            if self.request_ids[slot] is None:
+                raise ValueError(f"slot {slot} is not assigned")
+            self.reset_locked(slot)
+            heapq.heappush(self._free, slot)
+
+    def reset_locked(self, slot: int) -> None:
+        self.request_ids[slot] = None
+        self.pos[slot] = 0
+
+    def advance(self, slot: int, n: int = 1) -> int:
+        """Move a slot's write cursor after a decode step; returns the
+        new position.  Raising rather than clamping: a cursor past
+        ``max_seq`` means the engine failed to retire the request at its
+        token budget — ``dynamic_update_slice`` would silently clamp the
+        write onto the last row and corrupt the newest K/V."""
+        with self._lock:
+            new = self.pos[slot] + n
+            if new > self.max_seq:
+                raise RuntimeError(
+                    f"slot {slot} cursor {new} overran max_seq "
+                    f"{self.max_seq}")
+            self.pos[slot] = new
+            return new
+
+    # ---------------------------------------------------------- inspection
+
+    def active_slots(self) -> List[int]:
+        with self._lock:
+            return [i for i in range(self.n_slots)
+                    if self.request_ids[i] is not None]
+
+    @property
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def active_count(self) -> int:
+        return self.n_slots - self.free_count
+
+    def occupancy(self) -> float:
+        return self.active_count / self.n_slots

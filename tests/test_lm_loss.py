@@ -160,6 +160,7 @@ def test_early_exit_loss_equals_full_plus_weighted_truncated():
     np.testing.assert_allclose(float(got_f), float(got), rtol=1e-4)
 
 
+@pytest.mark.slow  # ~60s on CPU: trains two models to convergence
 def test_early_exit_training_makes_truncated_draft_viable():
     """The LayerSkip premise, end to end: vanilla training leaves the
     early-exit readout (ln_f + head over block_0) untrained, so the

@@ -88,6 +88,7 @@ def test_transformer_flash_sp_composes():
                                atol=3e-5, rtol=3e-5)
 
 
+@pytest.mark.slow  # ~65s on CPU: full MobileNetV2 compile + train step
 def test_mobilenet_v2_forward_and_train_step():
     from byteps_tpu.models import MobileNetV2
     from byteps_tpu.training import (

@@ -197,10 +197,19 @@ def _run_two_workers(tmp_path, source, ok_marker):
         assert ok_marker.format(wid=wid) in out, out
 
 
+# The three two-process tests spawn REAL worker subprocesses, each
+# paying a full jax + frontend import and distributed init: 30-70s
+# apiece, ~150s of tier-1 wall combined.  They run in the slow bucket
+# (pytest -m slow) — the single-process collective/sharding coverage
+# stays in tier-1.
+
+
+@pytest.mark.slow
 def test_two_process_push_pull(tmp_path):
     _run_two_workers(tmp_path, _WORKER, "WORKER_{wid}_OK")
 
 
+@pytest.mark.slow
 def test_two_process_torch_frontend(tmp_path):
     """byteps_tpu.torch across 2 real processes: worker==process semantics
     for push_pull (sum/avg/in-place) and broadcast_parameters."""
@@ -208,6 +217,7 @@ def test_two_process_torch_frontend(tmp_path):
     _run_two_workers(tmp_path, _TORCH_WORKER, "TORCH_WORKER_{wid}_OK")
 
 
+@pytest.mark.slow
 def test_two_process_tf_frontend(tmp_path):
     """byteps_tpu.tensorflow across 2 real processes: push_pull on tf
     tensors, DistributedGradientTape averaging, broadcast_variables, and

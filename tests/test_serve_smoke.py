@@ -1,0 +1,147 @@
+"""CI wiring for scripts/serve_smoke.py: randomized-arrival continuous
+batching must be token-identical to sequential ``generate()`` (greedy
+and seeded sampling), with a retrace-free decode program.
+
+Marked ``slow`` so tier-1 (-m 'not slow') stays fast; run explicitly
+with ``pytest -m slow tests/test_serve_smoke.py``.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "scripts"))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("temperature", [0.0, 0.8])
+def test_serve_smoke_randomized_arrival_parity(temperature):
+    import serve_smoke
+
+    stats = serve_smoke.run(requests=10, seed=0, n_slots=4,
+                            temperature=temperature, verbose=False)
+    assert stats["mismatches"] == 0
+    # steady-state compile stability: one decode program, bounded
+    # prefill buckets (power-of-two padding)
+    assert stats["decode_traces"] == 1
+    assert stats["prefill_buckets"] <= 4
+    assert stats["serve.requests_completed"] == 10
+
+
+@pytest.mark.slow
+def test_bench_serve_batching_beats_sequential(tmp_path):
+    """The acceptance bar: >= 1.5x aggregate tokens/sec at 8 concurrent
+    requests vs the sequential generate() baseline on CPU, with the
+    decode program traced exactly once per pool size (asserted inside
+    bench())."""
+    import bench_serve
+
+    result = bench_serve.bench(
+        out_path=str(tmp_path / "BENCH_SERVE.json"))
+    pts = {p["concurrency"]: p for p in result["points"]
+           if p["mode"] == "engine"}
+    assert pts[8]["speedup_vs_sequential"] >= 1.5, pts[8]
+    # continuous batching must scale from no-batching to batch-8 (strict
+    # 16>8 monotonicity is NOT asserted: a 2-core CI box saturates
+    # around batch 8 and 16-vs-8 is then noise), and the batch-16 point
+    # must still clear the same bar vs sequential
+    assert pts[8]["tokens_per_sec"] > 1.5 * pts[1]["tokens_per_sec"]
+    assert pts[16]["speedup_vs_sequential"] >= 1.5, pts[16]
+
+
+@pytest.mark.slow
+def test_tcp_frontend_roundtrip_and_backpressure():
+    """The launcher-facing TCP tier: concurrent RemoteServeClient
+    connections batch into one engine and return exact generate()
+    parity; a full admission queue surfaces the typed rejection as a
+    status=1 reply without killing the connection."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from byteps_tpu.inference import generate
+    from byteps_tpu.models.transformer import (Transformer,
+                                               TransformerConfig)
+    from byteps_tpu.serving import ServeMetrics, ServingEngine
+    from byteps_tpu.serving.frontend import RemoteServeClient, serve
+
+    cfg = TransformerConfig(vocab_size=61, num_layers=2, num_heads=2,
+                            d_model=32, d_ff=64, max_seq_len=64,
+                            dtype=jnp.float32)
+    model = Transformer(cfg)
+    variables = model.init(jax.random.PRNGKey(1),
+                           jnp.zeros((1, 8), jnp.int32))
+    prompts = [np.asarray(jax.random.randint(
+        jax.random.PRNGKey(10 + i), (5 + i,), 0, 61), np.int32)
+        for i in range(3)]
+    M = 6
+    base = [np.asarray(generate(model, variables, p[None], M,
+                                temperature=0.0)["tokens"])[0]
+            for p in prompts]
+    engine = ServingEngine(model, variables, n_slots=2, max_seq=64,
+                           metrics=ServeMetrics())
+    srv, _ = serve(engine, port=0, host="127.0.0.1", in_thread=True)
+    addr = "127.0.0.1:%d" % srv.server_address[1]
+    try:
+        outs = [None] * 3
+
+        def call(i):
+            c = RemoteServeClient(addr)
+            try:
+                outs[i] = c.generate(prompts[i], M)
+            finally:
+                c.close()
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for got, want in zip(outs, base):
+            np.testing.assert_array_equal(got, want)
+        c = RemoteServeClient(addr)
+        stats = c.stats()
+        assert stats["serve.requests_completed"] == 3
+        assert stats["compile_counts"]["decode"] == 1
+        # typed backpressure over the wire: stall admissions (stop the
+        # tick thread), fill the queue, and the reply is a status=1
+        # QueueFullError message on a connection that stays usable
+        engine.stop()
+        engine.scheduler.max_queue = 1
+        c2 = RemoteServeClient(addr)
+        done = threading.Event()
+
+        def first():  # occupies the single queue slot (blocks)
+            try:
+                c2.generate(prompts[0], 2)
+            except RuntimeError:
+                pass
+            finally:
+                done.set()
+
+        t = threading.Thread(target=first, daemon=True)
+        t.start()
+        import time
+
+        for _ in range(100):  # wait for the first submit to enqueue
+            if engine.scheduler.depth == 1:
+                break
+            time.sleep(0.02)
+        try:
+            c.generate(prompts[1], 2)
+            assert False, "expected QueueFullError over the wire"
+        except RuntimeError as e:
+            assert "QueueFullError" in str(e)
+        assert c.ping()  # connection survived the rejection
+        engine.start()  # let the stalled request finish
+        done.wait(60)
+        c.close()
+        c2.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()

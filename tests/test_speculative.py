@@ -10,6 +10,7 @@ agreement, plus EOS freezing inside an accepted block.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from byteps_tpu.inference import generate, speculative_generate
 from byteps_tpu.models.transformer import Transformer, TransformerConfig
@@ -111,6 +112,7 @@ def test_truncated_self_draft_exact_and_cheap():
         truncated_draft(target.cfg, tvars, 5)
 
 
+@pytest.mark.slow  # ~40s on CPU: trains the target model to convergence
 def test_truncated_draft_acceptance_rises_with_training():
     """The LayerSkip premise, empirically: on RANDOM weights a truncated
     self-draft is uncorrelated with the full model (acceptance ~0, the
