@@ -1,0 +1,214 @@
+"""Gradient wire-compression benchmark: bytes on the cross-machine link
+and loss parity per scheme (docs/compression.md).
+
+Two legs, both on the small-transformer workload:
+
+  * **wire leg** — the model's gradient-sized pytree is pushed through a
+    real ``RemoteStore`` -> in-thread PS server round-trip per scheme;
+    reported bytes are the *measured* payloads on the socket
+    (CompressionStats), not an analytic estimate, so framing overhead
+    and the per-partition headers are included.  ``reduction_vs_bf16``
+    is the acceptance-criteria number: onebit/topk must beat the bf16
+    cast by >=4x.
+  * **parity leg** — the same LM trained with
+    ``make_data_parallel_step(compression=scheme)`` on the dp=8 CPU
+    harness, identical init/data/steps per scheme; the loss curve shows
+    what error feedback buys (signSGD/top-k without EF would stall).
+
+Prints ONE JSON line per scheme (bench_comm.py convention) and writes
+the aggregate to BENCH_COMPRESS.json.  Runs anywhere:
+
+    JAX_PLATFORMS=cpu python bench_compress.py [--steps 40] [--out ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+WIRE_SCHEMES = ("none", "bf16", "fp16", "int8", "randomk", "topk", "onebit")
+PARITY_SCHEMES = ("none", "bf16", "onebit", "topk")
+
+
+def _model(vocab=256, layers=2, d_model=128, max_seq=64):
+    from byteps_tpu.models.transformer import Transformer, TransformerConfig
+
+    cfg = TransformerConfig(
+        vocab_size=vocab, num_layers=layers, num_heads=4, d_model=d_model,
+        d_ff=4 * d_model, max_seq_len=max_seq, dtype=jnp.float32)
+    model = Transformer(cfg)
+    tokens = jnp.zeros((1, 16), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    return model, params
+
+
+def _grad_tree(params, seed=0):
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    rng = np.random.default_rng(seed)
+    out = [rng.standard_normal(np.shape(l)).astype(np.float32) * 1e-2
+           for l in leaves]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+# ------------------------------------------------------------------ wire leg
+
+
+def bench_wire(params, scheme: str, sweeps: int = 3,
+               ratio: float = 0.01) -> dict:
+    """Push the gradient pytree through a real PS round-trip and read the
+    measured wire bytes off the socket path."""
+    from byteps_tpu.common.config import Config, reset_config, set_config
+    from byteps_tpu.compression import (get_compression_stats,
+                                        reset_compression_stats)
+    from byteps_tpu.engine import ps_server
+
+    reset_config()
+    reset_compression_stats()
+    set_config(Config(compression=scheme, compression_min_bytes=64,
+                      compression_ratio=ratio))
+    srv, _ = ps_server.serve(0, host="127.0.0.1", use_native=False,
+                             in_thread=True)
+    addr = f"127.0.0.1:{srv.server_address[1]}"
+    store = ps_server.RemoteStore([addr])
+    try:
+        flat = jax.tree_util.tree_leaves(params)
+        names = [f"g{i}" for i in range(len(flat))]
+        for n, leaf in zip(names, flat):
+            store.init_tensor(n, np.zeros(np.shape(leaf), np.float32))
+        grads = [np.asarray(g) for g in jax.tree_util.tree_leaves(
+            _grad_tree(params))]
+        t0 = time.perf_counter()
+        for _ in range(sweeps):
+            for n, g in zip(names, grads):
+                store.push_delta(n, g)
+        wall = time.perf_counter() - t0
+        s = get_compression_stats().summary()
+        return {
+            "scheme": scheme,
+            "raw_bytes": int(s["raw_bytes"]),
+            "wire_bytes": int(s["wire_bytes_sent"]),
+            "reduction_vs_raw": round(s["compression_ratio"], 2),
+            "push_wall_s": round(wall, 4),
+        }
+    finally:
+        store.close()
+        srv.shutdown()
+        srv.server_close()
+        reset_config()
+        reset_compression_stats()
+
+
+# ---------------------------------------------------------------- parity leg
+
+
+def bench_parity(scheme: str, steps: int, batch: int = 16, seq: int = 32,
+                 ratio: float = 0.05) -> dict:
+    """Train the small transformer with ``compression=scheme`` on the
+    dp mesh; identical init/data across schemes."""
+    import byteps_tpu as bps
+    from byteps_tpu.common.config import Config, reset_config, set_config
+    from byteps_tpu.training import (lm_loss_fn, make_data_parallel_step,
+                                     shard_batch)
+
+    reset_config()
+    set_config(Config(compression_ratio=ratio))
+    model, params = _model()
+    mesh = bps.mesh()
+    step = make_data_parallel_step(
+        lm_loss_fn(model), optax.adam(1e-3), mesh, compression=scheme)
+    state = step.init_state(params)
+    rng = np.random.default_rng(42)
+    tokens = rng.integers(0, 256, (steps, batch, seq)).astype(np.int32)
+    curve = []
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, metrics = step(state, shard_batch({"tokens": tokens[i]},
+                                                 mesh))
+        curve.append(float(metrics["loss"]))
+    wall = time.perf_counter() - t0
+    reset_config()
+    return {
+        "scheme": scheme,
+        "loss_first": round(curve[0], 4),
+        "loss_final": round(curve[-1], 4),
+        "loss_curve": [round(v, 4) for v in curve],
+        "step_wall_s": round(wall / steps, 4),
+    }
+
+
+# --------------------------------------------------------------------- main
+
+
+def run(steps: int = 40, sweeps: int = 3,
+        out_path: str = "BENCH_COMPRESS.json") -> dict:
+    import byteps_tpu as bps
+
+    bps.init()
+    _, params = _model()
+    nparams = sum(int(np.prod(np.shape(l)))
+                  for l in jax.tree_util.tree_leaves(params))
+
+    wire = {}
+    for scheme in WIRE_SCHEMES:
+        r = bench_wire(params, scheme, sweeps=sweeps)
+        wire[scheme] = r
+        print(json.dumps({"leg": "wire", **r}))
+    bf16_bytes = wire["bf16"]["wire_bytes"]
+    for scheme, r in wire.items():
+        r["reduction_vs_bf16"] = round(bf16_bytes / r["wire_bytes"], 2)
+
+    parity = {}
+    for scheme in PARITY_SCHEMES:
+        r = bench_parity(scheme, steps=steps)
+        parity[scheme] = r
+        print(json.dumps({"leg": "parity", "scheme": scheme,
+                          "loss_first": r["loss_first"],
+                          "loss_final": r["loss_final"],
+                          "step_wall_s": r["step_wall_s"]}))
+
+    base = parity["none"]
+    drop_none = base["loss_first"] - base["loss_final"]
+    for scheme, r in parity.items():
+        r["final_gap_vs_none"] = round(r["loss_final"] - base["loss_final"],
+                                       4)
+        # parity score: fraction of the uncompressed run's loss drop the
+        # compressed run achieved (1.0 = identical progress)
+        drop = r["loss_first"] - r["loss_final"]
+        r["progress_vs_none"] = round(drop / drop_none, 4) if drop_none else 1.0
+
+    result = {
+        "bench_version": 1,
+        "model_params": nparams,
+        "wire_sweeps": sweeps,
+        "parity_steps": steps,
+        "wire": wire,
+        "parity": parity,
+    }
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+    print(f"wrote {out_path}: onebit {wire['onebit']['reduction_vs_bf16']}x "
+          f"/ topk {wire['topk']['reduction_vs_bf16']}x vs bf16; "
+          f"onebit progress {parity['onebit']['progress_vs_none']:.2f} of "
+          "uncompressed")
+    bps.shutdown()
+    return result
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--steps", type=int, default=40)
+    ap.add_argument("--sweeps", type=int, default=3)
+    ap.add_argument("--out", type=str, default="BENCH_COMPRESS.json")
+    args = ap.parse_args()
+    run(steps=args.steps, sweeps=args.sweeps, out_path=args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

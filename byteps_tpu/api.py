@@ -201,13 +201,49 @@ def _auto_name(prefix: str = "byteps_push_pull") -> str:
     return f"{prefix}_{_name_counter[0]}"
 
 
+_roundtrip_counter = [0]
+
+
+def _maybe_roundtrip(tensor, compression, stacked: bool = False,
+                     name: str = ""):
+    """Apply a biased registry scheme's compress→decompress to eager
+    contributions (cast schemes ride the engine's wire_dtype instead).
+    ``stacked=True`` treats dim 0 as the worker axis and compresses each
+    row independently — per-contribution scales, matching what each
+    worker would put on a real wire.
+
+    Seeded schemes fold (config seed, tensor name, per-process call
+    counter) like the wire path's ``derive_seed``, so successive pushes
+    of the same tensor move the random-k mask instead of freezing one
+    coordinate subset forever.  This path is still stateless (no error
+    feedback) — one-shot reductions only; training loops must use
+    DistributedOptimizer, whose EF state carries the unsent mass.
+    """
+    scheme = getattr(compression, "scheme", None)
+    if scheme is None or not scheme.biased:
+        return tensor
+    cfg = get_config()
+    key = None
+    if scheme.seeded:
+        from .compression import derive_seed
+
+        _roundtrip_counter[0] += 1
+        key = jax.random.PRNGKey(derive_seed(
+            cfg.compression_seed, name, _roundtrip_counter[0]))
+
+    def one(row):
+        return scheme.roundtrip(row, key=key, ratio=cfg.compression_ratio)
+
+    return jax.vmap(one)(tensor) if stacked else one(jnp.asarray(tensor))
+
+
 def push_pull(
     tensor,
     average: bool = True,
     name: Optional[str] = None,
     version: int = 0,
     priority: int = 0,
-    compression: type = Compression.none,
+    compression: Any = Compression.none,
     axis_name: Optional[Any] = None,
 ):
     """Sum (or average) a tensor across workers.
@@ -223,7 +259,14 @@ def push_pull(
       * **eager** — ``tensor`` is either one worker's contribution when
         ``size()==1``, or contributions stacked on a leading worker axis
         (shape ``[size(), ...]``).  Blocks until the result is ready.
+
+    ``compression`` accepts a Compressor class or a registry scheme name
+    (``"bf16"``, ``"onebit"``, ... — docs/compression.md).  Biased
+    schemes apply statelessly here (compress→decompress on each
+    contribution, no error feedback): right for one-shot reductions;
+    training loops should carry EF via DistributedOptimizer instead.
     """
+    compression = Compression.resolve(compression)
     if axis_name is not None:
         compressed, ctx = compression.compress(tensor)
         axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
@@ -247,7 +290,7 @@ def push_pull_async(
     name: Optional[str] = None,
     version: int = 0,
     priority: int = 0,
-    compression: type = Compression.none,
+    compression: Any = Compression.none,
 ) -> int:
     """Async eager push_pull; returns a handle (reference torch/ops.py:144-183).
 
@@ -259,10 +302,13 @@ def push_pull_async(
     leading worker axis and drained by the engine's scheduler threads.
     """
     _require_init()
+    compression = Compression.resolve(compression)
     engine = _dispatcher.get_engine()
     wire = getattr(compression, "wire_dtype", None)
     if jax.process_count() > 1:
-        return _multihost_push_pull(tensor, average=average, wire=wire)
+        return _multihost_push_pull(
+            _maybe_roundtrip(tensor, compression, name=name or ""),
+            average=average, wire=wire)
     n = size()
     tensor = jnp.asarray(tensor)
     if n == 1:
@@ -275,6 +321,8 @@ def push_pull_async(
             f"on a leading worker axis of length {n}; got shape {tensor.shape}. "
             "Inside a jitted step, pass axis_name= instead."
         )
+    stacked = _maybe_roundtrip(stacked, compression, stacked=True,
+                               name=name or "")
     return engine.push_pull_async(
         stacked,
         name or _auto_name(),
@@ -367,7 +415,7 @@ def push_pull_async_process(
     name: Optional[str] = None,
     version: int = 0,
     priority: int = 0,
-    compression: type = Compression.none,
+    compression: Any = Compression.none,
 ) -> int:
     """Eager push_pull with **one worker == one process** semantics in every
     topology (the reference's Horovod contract: a training process
@@ -377,8 +425,10 @@ def push_pull_async_process(
     for API parity (the reduce runs synchronously as one SPMD program)."""
     del name, version, priority
     _require_init()
+    compression = Compression.resolve(compression)
     wire = getattr(compression, "wire_dtype", None)
-    return _multihost_push_pull(tensor, average=average, wire=wire)
+    return _multihost_push_pull(_maybe_roundtrip(tensor, compression),
+                                average=average, wire=wire)
 
 
 def _multihost_push_pull(tensor, average: bool, wire) -> int:

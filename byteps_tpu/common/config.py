@@ -144,6 +144,16 @@ class Config:
     serve_model: str = ""         # "k=v,..." TransformerConfig overrides
     serve_checkpoint: str = ""    # params checkpoint for the serve role
 
+    # --- gradient wire compression (byteps_tpu/compression/; the
+    # reference reserved kCompressedPushPull, common.h:212-216, and never
+    # implemented it — docs/compression.md) ------------------------------
+    compression: str = ""          # default wire scheme; "" = none
+    compression_min_bytes: int = 1024   # raw pass-through below this
+    compression_overrides: str = ""     # "substring=scheme,..." per-name
+    compression_ratio: float = 0.01     # k/n for topk / randomk
+    compression_seed: int = 0           # base seed (randomk / int8 dither)
+    compression_reply: str = ""         # server reply cast: ""|bf16|fp16
+
     # --- TPU-specific ----------------------------------------------------
     wire_dtype: str = ""  # "" (no compression) | "bf16" | "fp16"
     mesh_shape: str = ""  # e.g. "dp=8" or "dcn=2,dp=4"; "" = auto
@@ -192,6 +202,13 @@ class Config:
             serve_eos_id=_env_opt_int("BYTEPS_SERVE_EOS_ID"),
             serve_model=_env_str("BYTEPS_SERVE_MODEL", ""),
             serve_checkpoint=_env_str("BYTEPS_SERVE_CHECKPOINT", ""),
+            compression=_env_str("BYTEPS_COMPRESSION", ""),
+            compression_min_bytes=_env_int("BYTEPS_MIN_COMPRESS_BYTES", 1024),
+            compression_overrides=_env_str(
+                "BYTEPS_COMPRESSION_OVERRIDES", ""),
+            compression_ratio=_env_float("BYTEPS_COMPRESSION_RATIO", 0.01),
+            compression_seed=_env_int("BYTEPS_COMPRESSION_SEED", 0),
+            compression_reply=_env_str("BYTEPS_COMPRESSION_REPLY", ""),
             wire_dtype=_env_str("BYTEPS_WIRE_DTYPE", ""),
             mesh_shape=_env_str("BYTEPS_MESH_SHAPE", ""),
         )

@@ -1,0 +1,29 @@
+"""Gradient wire-compression subsystem (docs/compression.md).
+
+Pluggable error-feedback compressors on the cross-machine path — the
+"compress after local aggregation, before the wire" point that BytePS's
+push/pull architecture exposes and its protocol enum reserved but never
+implemented (``kCompressedPushPull``, reference common.h:212-216).
+
+Layers:
+
+  * ``registry``       — scheme table (none/bf16/fp16/int8/topk/randomk/
+                         onebit), jit roundtrips + numpy wire codecs,
+                         per-tensor ``CompressionPolicy``;
+  * ``error_feedback`` — optax EF transformation for the jitted
+                         collective path (residual in the optimizer
+                         state: donated, checkpointable);
+  * ``wire``           — versioned blob framing + ``WireCompressor``
+                         (RemoteStore-side EF with post-ack commit);
+  * ``stats``          — wire_bytes_sent/saved Tracer tracks + run-end
+                         summary.
+"""
+
+from .error_feedback import (EFCompressState, compression_roundtrip,  # noqa: F401
+                             error_feedback_compress)
+from .registry import (SCHEMES, CompressionPolicy, Scheme,  # noqa: F401
+                       derive_seed, get_scheme, register_scheme)
+from .stats import (CompressionStats, get_compression_stats,  # noqa: F401
+                    reset_compression_stats)
+from .wire import (WIRE_TAG, WireBlob, WireCompressor, decode_blob,  # noqa: F401
+                   encode_blob, maybe_compress_reply)

@@ -1,0 +1,140 @@
+"""Error-feedback compression as an optax transformation — the jit-domain
+half of the wire-compression subsystem.
+
+``error_feedback_compress(scheme)`` generalizes
+``ops/quantization.error_feedback_quantize_gradients`` to every registry
+scheme: per leaf, ``corrected = g + e``; the *compressed-then-
+decompressed* value is what flows on to the communication/optimizer
+chain (so every worker contributes identical low-precision payloads),
+and ``e' = corrected - deq`` carries the unsent part to the next step —
+the fix that makes biased compressors (signSGD, top-k) converge
+(Karimireddy et al., ICML'19; Lin et al., ICLR'18).
+
+The residual lives in the optimizer state as an ordinary pytree leaf
+set: jit-friendly (no host round-trips), donated along with the rest of
+the ``TrainState`` (training/step.py donates argnum 0), and
+checkpointable by ``training/checkpoint.py`` with zero extra code — a
+resumed run continues the EF carry instead of dropping it.
+
+Seeded schemes (randomk, dithered int8) fold ``(seed, step counter,
+leaf index)`` into a PRNG key kept in the state, so a re-executed step
+(same state in, e.g. a recomputed microbatch) replays the same
+coordinates — deterministic by construction, mirroring the wire path's
+``derive_seed`` contract.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .registry import Scheme, get_scheme
+
+
+class EFCompressState(NamedTuple):
+    error: Any       # pytree of fp32 residuals, same structure as grads
+    count: jax.Array  # int32 step counter -> per-step seeds
+
+
+def _map_with_index(fn, updates, error):
+    """Leafwise ``fn(i, g, e) -> (new_g, new_e)`` over matching pytrees
+    (flatten/unflatten like ops.quantization.map_ef_pairs, plus the leaf
+    index seeded schemes need for per-leaf keys)."""
+    g_flat, treedef = jax.tree_util.tree_flatten(updates)
+    e_flat = jax.tree_util.tree_leaves(error)
+    if len(e_flat) != len(g_flat):
+        raise ValueError(
+            f"gradient/error pytree mismatch: {len(g_flat)} vs {len(e_flat)}"
+            " leaves — was the optimizer state initialized for these params?")
+    outs = [fn(i, g, e) for i, (g, e) in enumerate(zip(g_flat, e_flat))]
+    return (jax.tree_util.tree_unflatten(treedef, [o[0] for o in outs]),
+            jax.tree_util.tree_unflatten(treedef, [o[1] for o in outs]))
+
+
+def error_feedback_compress(
+    scheme: Union[str, Scheme],
+    ratio: Optional[float] = None,
+    seed: Optional[int] = None,
+) -> optax.GradientTransformation:
+    """Optax transformation: compress incoming gradients under ``scheme``
+    (through the dequantized payload) with error feedback.
+
+    Chain it BEFORE the communication transformation — compression
+    happens after local aggregation, before the wire, exactly the point
+    the reduce-scatter → push architecture exposes::
+
+        tx = optax.chain(
+            error_feedback_compress("onebit"),
+            bps.training.push_pull_gradients(axis_name="dp"),
+            optax.sgd(0.1),
+        )
+
+    ``ratio``/``seed`` default from config (``BYTEPS_COMPRESSION_RATIO``
+    / ``BYTEPS_COMPRESSION_SEED``).
+    """
+    sch = get_scheme(scheme) if isinstance(scheme, str) else scheme
+    if ratio is None or seed is None:
+        from ..common.config import get_config
+
+        cfg = get_config()
+        ratio = cfg.compression_ratio if ratio is None else ratio
+        seed = cfg.compression_seed if seed is None else seed
+
+    def init_fn(params):
+        return EFCompressState(
+            error=jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params),
+            count=jnp.zeros((), jnp.int32),
+        )
+
+    def update_fn(updates, state, params=None):
+        del params
+        step_key = None
+        if sch.seeded:
+            step_key = jax.random.fold_in(jax.random.PRNGKey(seed),
+                                          state.count)
+
+        def one(i, g, e):
+            corrected = g.astype(jnp.float32) + e
+            key = (jax.random.fold_in(step_key, i)
+                   if step_key is not None else None)
+            deq = sch.roundtrip(corrected, key=key, ratio=ratio)
+            deq = deq.astype(jnp.float32)
+            return deq.astype(g.dtype), corrected - deq
+
+        new_updates, new_error = _map_with_index(one, updates, state.error)
+        return new_updates, EFCompressState(error=new_error,
+                                            count=state.count + 1)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def compression_roundtrip(
+    scheme: Union[str, Scheme],
+    ratio: Optional[float] = None,
+) -> optax.GradientTransformation:
+    """Stateless compress→decompress per gradient, NO error feedback —
+    the world==1 mirror of what an unbiased cast scheme does to each
+    contribution on a multi-worker wire (cast in, reduce, cast out), so
+    single- and multi-process runs see the same numerics
+    (training/step.py uses it for ``bf16``/``fp16``/legacy Compressor
+    classes)."""
+    sch = get_scheme(scheme) if isinstance(scheme, str) else scheme
+    if ratio is None:
+        from ..common.config import get_config
+
+        ratio = get_config().compression_ratio
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        del params
+        return (jax.tree_util.tree_map(
+            lambda g: sch.roundtrip(g, ratio=ratio), updates), state)
+
+    return optax.GradientTransformation(init_fn, update_fn)

@@ -1,0 +1,107 @@
+"""Wire-compression observability — ``serving/metrics.py`` pattern.
+
+Every payload RemoteStore puts on the cross-machine link bumps two
+monotonic counters, per tensor and in total:
+
+  * ``compression.wire_bytes_sent``  — bytes actually sent;
+  * ``compression.wire_bytes_saved`` — raw bytes minus wire bytes (what
+    compression kept off the link).
+
+With ``BYTEPS_TRACE_PATH`` set they land on the shared chrome-trace
+timeline as counter tracks (one global track each, plus a per-tensor
+instant event carrying the tensor name), so wire savings render next to
+the push/pull spans in Perfetto.  ``log_summary()`` — called from
+``RemoteStore.close()`` — emits the run-end one-liner.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+from ..common import logging as bps_log
+
+WIRE_BYTES_SENT = "compression.wire_bytes_sent"
+WIRE_BYTES_SAVED = "compression.wire_bytes_saved"
+
+
+class CompressionStats:
+    """Thread-safe per-tensor wire byte accounting with Tracer surfacing."""
+
+    def __init__(self, tracer=None):
+        self._lock = threading.Lock()
+        self._per_tensor: Dict[str, Tuple[int, int]] = {}  # name -> (raw, wire)
+        self._raw_total = 0
+        self._wire_total = 0
+        self._tracer = tracer
+
+    def _get_tracer(self):
+        if self._tracer is not None:
+            return self._tracer
+        from ..common.tracing import get_tracer
+
+        return get_tracer()
+
+    def observe(self, name: str, raw_bytes: int, wire_bytes: int) -> None:
+        with self._lock:
+            r, w = self._per_tensor.get(name, (0, 0))
+            self._per_tensor[name] = (r + raw_bytes, w + wire_bytes)
+            self._raw_total += raw_bytes
+            self._wire_total += wire_bytes
+            sent, saved = self._wire_total, self._raw_total - self._wire_total
+        tracer = self._get_tracer()
+        if tracer.enabled:
+            tracer.counter(WIRE_BYTES_SENT, sent, "compression")
+            tracer.counter(WIRE_BYTES_SAVED, saved, "compression")
+            tracer.instant(WIRE_BYTES_SENT, "compression", tensor=name,
+                           raw=raw_bytes, wire=wire_bytes)
+
+    # ------------------------------------------------------------ reporting
+
+    def per_tensor(self) -> Dict[str, Tuple[int, int]]:
+        with self._lock:
+            return dict(self._per_tensor)
+
+    def summary(self) -> Dict[str, float]:
+        with self._lock:
+            raw, wire = self._raw_total, self._wire_total
+            tensors = len(self._per_tensor)
+        return {
+            "raw_bytes": raw,
+            "wire_bytes_sent": wire,
+            "wire_bytes_saved": raw - wire,
+            "compression_ratio": (raw / wire) if wire else 1.0,
+            "tensors": tensors,
+        }
+
+    def log_summary(self) -> Optional[str]:
+        """The run-end summary line; returns it (None when nothing was
+        observed, so idle clients stay silent)."""
+        s = self.summary()
+        if not s["raw_bytes"]:
+            return None
+        line = ("wire compression: %.1f MB raw -> %.1f MB sent "
+                "(%.2fx, %.1f MB saved) across %d tensors" % (
+                    s["raw_bytes"] / 1e6, s["wire_bytes_sent"] / 1e6,
+                    s["compression_ratio"], s["wire_bytes_saved"] / 1e6,
+                    s["tensors"]))
+        bps_log.info(line)
+        return line
+
+
+_stats: Optional[CompressionStats] = None
+_stats_lock = threading.Lock()
+
+
+def get_compression_stats() -> CompressionStats:
+    global _stats
+    with _stats_lock:
+        if _stats is None:
+            _stats = CompressionStats()
+        return _stats
+
+
+def reset_compression_stats() -> None:
+    global _stats
+    with _stats_lock:
+        _stats = None

@@ -44,6 +44,19 @@ retried mutation whose first reply was lost mid-connection can ask
 ``OP_VERSION`` whether the server already applied it (exactly-once under
 connection resets for a single writer per key — see
 resilience/policy.py and docs/resilience.md).
+
+Compressed payloads (byteps_tpu/compression — docs/compression.md) ride
+the same frame under the versioned dtype tag ``"bpsc1"``: the payload is
+a scheme-tagged blob (scheme name + ctx + data) instead of raw numpy
+bytes, while the frame's shape field keeps the original dimensions.  The
+server decompresses at decode time and sums the dense fp32 result into
+the store; replies (PULL / PUSH_PULL / INIT-loser) are cast-compressed
+per ``BYTEPS_COMPRESSION_REPLY``.  A peer that predates the subsystem
+fails loudly on the unknown dtype name — never a silent misread.
+``RemoteStore`` additionally partitions tensors larger than
+``BYTEPS_PARTITION_BYTES`` into independently keyed ``name#p{i}`` parts
+(reference PartitionTensor, operations.cc:95-132) so compression,
+version-guarded retries and shard placement all happen per partition.
 """
 
 from __future__ import annotations
@@ -59,6 +72,7 @@ import numpy as np
 
 from ..common import logging as bps_log
 from ..common.context import name_key
+from ..compression.wire import WIRE_MAGIC, WireBlob, decode_blob
 from .async_ps import AsyncParameterServer
 
 (OP_INIT, OP_PUSH_PULL, OP_PULL, OP_VERSION, OP_NAMES, OP_PING, OP_PUSH,
@@ -110,10 +124,18 @@ def hard_reset(sock: socket.socket) -> None:
         pass
 
 
-def _encode(op: int, name: str, arr: Optional[np.ndarray],
+def _encode(op: int, name: str, arr,
             raw: bytes = b"") -> bytes:
     nb = name.encode()
-    if arr is not None:
+    if isinstance(arr, WireBlob):
+        # compressed payload: versioned dtype tag, original shape in the
+        # frame header, scheme-tagged blob as the payload
+        from ..compression.wire import WIRE_TAG
+
+        dt = WIRE_TAG.encode()
+        shape = arr.shape
+        payload = arr.data
+    elif arr is not None:
         arr = np.ascontiguousarray(arr)
         dt = _dtype_to_wire(arr.dtype)
         shape = arr.shape
@@ -146,7 +168,14 @@ def _decode(sock: socket.socket):
     payload = _recv_exact(sock, plen) if plen else b""
     arr = None
     if dt:
-        arr = np.frombuffer(payload, dtype=_wire_to_dtype(dt)).reshape(shape)
+        if dt.startswith(WIRE_MAGIC):
+            # compressed frame: decompress here so both ends of the wire
+            # (server request leg, client reply leg) see a dense array —
+            # version/framing mismatches raise loudly in decode_blob
+            arr = decode_blob(dt, payload, shape)
+        else:
+            arr = np.frombuffer(payload,
+                                dtype=_wire_to_dtype(dt)).reshape(shape)
     return op, name, arr, payload
 
 
@@ -291,6 +320,9 @@ class _Handler(socketserver.BaseRequestHandler):
         store: AsyncParameterServer = self.server.store  # type: ignore[attr-defined]
         profiler: Optional[ServerProfiler] = getattr(
             self.server, "profiler", None)
+        # reply-leg cast compression (BYTEPS_COMPRESSION_REPLY): identity
+        # unless configured; biased schemes are refused inside the helper
+        reply_c = getattr(self.server, "reply_compress", lambda a: a)
         peer = "%s:%s" % self.client_address[:2]
         sock = self.request
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -320,8 +352,9 @@ class _Handler(socketserver.BaseRequestHandler):
                             if v is None:
                                 v = store.version(name)
                             created = False
-                        reply = _encode(0, str(v),
-                                        None if created else store.pull(name))
+                        reply = _encode(
+                            0, str(v),
+                            None if created else reply_c(store.pull(name)))
                     elif op == OP_PUSH_PULL:
                         # version must be read under the same lock as the
                         # add, or a concurrent mutation's counter gets
@@ -332,7 +365,7 @@ class _Handler(socketserver.BaseRequestHandler):
                         else:
                             out = store.push_pull(name, arr)
                             v = store.version(name)
-                        reply = _encode(0, str(v), out)
+                        reply = _encode(0, str(v), reply_c(out))
                     elif op == OP_PUSH:
                         v = store.push_delta(name, arr)
                         if v is None:
@@ -344,7 +377,7 @@ class _Handler(socketserver.BaseRequestHandler):
                             v = store.version(name)
                         reply = _encode(0, str(v), None)
                     elif op == OP_PULL:
-                        reply = _encode(0, "", store.pull(name))
+                        reply = _encode(0, "", reply_c(store.pull(name)))
                     elif op == OP_VERSION:
                         reply = _encode(0, "", None,
                                         struct.pack("<Q", store.version(name)))
@@ -390,6 +423,15 @@ class PSServer(socketserver.ThreadingTCPServer):
             from ..common.config import get_config
 
             cfg = get_config()
+            if cfg.compression_reply:
+                from ..compression.wire import maybe_compress_reply
+
+                self.reply_compress = (
+                    lambda a, _s=cfg.compression_reply,
+                    _m=cfg.compression_min_bytes:
+                    maybe_compress_reply(a, _s, _m))
+                bps_log.info("ps_server: reply compression -> %s",
+                             cfg.compression_reply)
             if cfg.server_enable_profile:
                 self.profiler = ServerProfiler(
                     cfg.server_profile_output_path, cfg.server_key_to_profile)
@@ -476,13 +518,27 @@ class RemoteStore:
         or auto-started on first failover) watches the dead shard; when
         it answers ``OP_PING`` again, failed-over keys migrate back
         (pull latest from the fallback, re-init the restarted shard).
+
+    Wire compression (byteps_tpu/compression — docs/compression.md):
+    PUSH / PUSH_PULL deltas are compressed per the policy
+    (``BYTEPS_COMPRESSION`` or the ``compression=`` argument); biased
+    schemes run under client-side error feedback whose residual is
+    committed only AFTER the version-guarded ack, so a replayed PUSH
+    resends the exact same compressed bytes and never double-folds the
+    residual.  Tensors above ``BYTEPS_PARTITION_BYTES`` are split into
+    independently keyed ``name#p{i}`` partitions (compressed, retried
+    and placed per partition; ``names()`` lists partition names).
+    Partitioned tensors must be init'd or pushed through this client
+    before ``pull``/``version`` can reassemble them.
     """
 
     def __init__(self, addrs: List[str], use_hash: bool = False,
                  timeout: float = 30.0, retry_policy=None, counters=None,
-                 heartbeat: Optional[float] = None):
+                 heartbeat: Optional[float] = None, compression=None):
         from ..common.config import get_config
         from ..common.context import ServerSharder
+        from ..compression import (CompressionPolicy, WireCompressor,
+                                   get_compression_stats)
         from ..resilience import (DegradedModeRouter, RetryPolicy,
                                   get_counters)
         from ..resilience import counters as cn
@@ -525,6 +581,24 @@ class RemoteStore:
         # primary and the fallback, and comparing across them would
         # corrupt the retry-dedup decision.
         self._pushed_version: dict = {}
+        # wire compression: explicit policy object > scheme-name string >
+        # env config; stats go to the process-global track so every
+        # client's bytes land on one Tracer timeline
+        if isinstance(compression, CompressionPolicy):
+            policy = compression
+        elif compression is not None:
+            policy = CompressionPolicy(
+                default=compression,
+                min_bytes=cfg.compression_min_bytes,
+                overrides=cfg.compression_overrides,
+                ratio=cfg.compression_ratio,
+                seed=cfg.compression_seed)
+        else:
+            policy = CompressionPolicy.from_config(cfg)
+        self._wire_stats = get_compression_stats()
+        self._compressor = WireCompressor(policy, stats=self._wire_stats)
+        self._partition_bytes = cfg.effective_partition_bytes
+        self._part_meta: dict = {}  # base name -> (nparts, shape, dtype)
         self._hb_interval = cfg.heartbeat_interval_ms / 1e3
         self._hb_timeout = cfg.heartbeat_timeout_ms / 1e3
         self._hb_threshold = cfg.heartbeat_miss_threshold
@@ -890,26 +964,127 @@ class RemoteStore:
 
     # ------------------------------------------------- store interface
 
+    def _partition(self, name: str, arr: np.ndarray):
+        """Split ``arr`` into the wire partitions of ``name`` (reference
+        PartitionTensor, operations.cc:95-132): ``[(wire_name, part)]``,
+        flat slices for multi-part tensors, and record the reassembly
+        meta so ``pull``/``version`` can find the parts later.  Each
+        partition is compressed, version-guarded and shard-placed
+        independently — priority interleaving on the wire happens at
+        partition granularity, like the scheduler's."""
+        from ..common.partition import partition_offsets
+
+        arr = np.ascontiguousarray(arr)
+        parts = partition_offsets(arr.nbytes, self._partition_bytes)
+        with self._state_lock:
+            self._part_meta[name] = (max(1, len(parts)), arr.shape,
+                                     arr.dtype)
+        if len(parts) <= 1:
+            return [(name, arr)]
+        flat = arr.reshape(-1)
+        itemsize = arr.dtype.itemsize
+        return [(f"{name}#p{i}",
+                 flat[off // itemsize:(off + length) // itemsize])
+                for i, (off, length) in enumerate(parts)]
+
+    def _part_names(self, name: str):
+        """Reassembly meta, or None for an unpartitioned/unknown name."""
+        with self._state_lock:
+            meta = self._part_meta.get(name)
+        if meta is None or meta[0] == 1:
+            return None
+        return meta
+
+    def _discover_parts(self, name: str):
+        """A client that never pushed ``name`` has no reassembly meta; a
+        tensor partitioned by ANOTHER client still lives on the servers
+        as ``name#p{i}`` keys.  Discover them via ``names()`` and cache a
+        flat-shaped meta (the original shape is client-local knowledge —
+        callers reshape against their own template).  Returns the meta or
+        None when the name genuinely does not exist partitioned."""
+        prefix = f"{name}#p"
+        idx = []
+        for n in self.names():
+            if n.startswith(prefix) and n[len(prefix):].isdigit():
+                idx.append(int(n[len(prefix):]))
+        if not idx or sorted(idx) != list(range(len(idx))):
+            return None
+        out, _ = self._rpc(self._shard_of(f"{name}#p0"), OP_PULL,
+                           f"{name}#p0")
+        part0 = np.asarray(out)
+        bps_log.warning(
+            "%r was partitioned by another client; reassembling %d parts "
+            "as a flat [n] array (original shape is client-local — "
+            "reshape against your template)", name, len(idx))
+        meta = (len(idx), None, part0.dtype)
+        with self._state_lock:
+            self._part_meta[name] = meta
+        return meta
+
     def init_tensor(self, name: str, value: np.ndarray) -> None:
-        self._rpc(self._shard_of(name), OP_INIT, name, np.asarray(value))
+        # INIT stays raw: it seeds the authoritative global state, which
+        # must not start life quantized
+        for pname, part in self._partition(name, np.asarray(value)):
+            self._rpc(self._shard_of(pname, part.nbytes), OP_INIT, pname,
+                      part)
 
     def push_delta(self, name: str, delta: np.ndarray) -> None:
-        d = np.asarray(delta)
         # OP_PUSH replies status-only: no pointless global-tensor download
-        self._rpc(self._shard_of(name, d.nbytes), OP_PUSH, name, d)
+        for pname, part in self._partition(name, np.asarray(delta)):
+            payload, commit = self._compressor.encode_mutation(pname, part)
+            self._rpc(self._shard_of(pname, part.nbytes), OP_PUSH, pname,
+                      payload)
+            if commit is not None:
+                commit()  # EF residual: only after the version-guarded ack
 
     def pull(self, name: str) -> np.ndarray:
-        out, _ = self._rpc(self._shard_of(name), OP_PULL, name)
-        return np.array(out)  # own the buffer
+        meta = self._part_names(name)
+        if meta is None:
+            try:
+                out, _ = self._rpc(self._shard_of(name), OP_PULL, name)
+                return np.array(out)  # own the buffer
+            except RuntimeError as e:
+                # possibly a tensor partitioned by another client (this
+                # one holds no meta): the store only knows name#p{i}
+                if "KeyError" not in str(e):
+                    raise
+                meta = self._discover_parts(name)
+                if meta is None:
+                    raise
+        nparts, shape, dtype = meta
+        chunks = []
+        for i in range(nparts):
+            pname = f"{name}#p{i}"
+            out, _ = self._rpc(self._shard_of(pname), OP_PULL, pname)
+            chunks.append(np.asarray(out).reshape(-1))
+        flat = np.concatenate(chunks).astype(dtype, copy=False)
+        return flat if shape is None else flat.reshape(shape)
 
     def push_pull(self, name: str, delta: np.ndarray) -> np.ndarray:
         d = np.asarray(delta)
-        out, _ = self._rpc(self._shard_of(name, d.nbytes), OP_PUSH_PULL,
-                           name, d)
-        return np.array(out)
+        outs = []
+        for pname, part in self._partition(name, d):
+            payload, commit = self._compressor.encode_mutation(pname, part)
+            out, _ = self._rpc(self._shard_of(pname, part.nbytes),
+                               OP_PUSH_PULL, pname, payload)
+            if commit is not None:
+                commit()  # EF residual: only after the version-guarded ack
+            outs.append(np.asarray(out).reshape(-1))
+        if len(outs) == 1:
+            return np.array(outs[0]).reshape(d.shape)
+        return np.concatenate(outs).reshape(d.shape)
 
     def version(self, name: str) -> int:
-        _, payload = self._rpc(self._shard_of(name), OP_VERSION, name)
+        meta = self._part_names(name)
+        qname = name if meta is None else f"{name}#p0"
+        try:
+            _, payload = self._rpc(self._shard_of(qname), OP_VERSION, qname)
+        except RuntimeError as e:
+            if (meta is not None or "KeyError" not in str(e)
+                    or self._discover_parts(name) is None):
+                raise
+            qname = f"{name}#p0"
+            _, payload = self._rpc(self._shard_of(qname), OP_VERSION, qname)
         return struct.unpack("<Q", payload)[0]
 
     def names(self) -> List[str]:
@@ -949,3 +1124,8 @@ class RemoteStore:
                     s.close()
                 finally:
                     self._socks[i] = None
+        try:
+            # run-end wire summary (one line; silent when nothing was sent)
+            self._wire_stats.log_summary()
+        except Exception:  # pragma: no cover - logging must never mask close
+            pass

@@ -6,6 +6,11 @@ and a ``Compression`` namespace exposing ``none`` and ``fp16``.
 TPU addition: ``bf16`` — bfloat16 shares float32's exponent range, so it is
 the safe default wire format on TPU (no overflow scaling needed, and the
 VPU/ICI move it natively).
+
+The full compressor registry (onebit / topk / randomk / int8 with error
+feedback — docs/compression.md) lives in ``byteps_tpu/compression``;
+``Compression.resolve`` bridges its registry names into this Compressor
+protocol so every ``compression=`` entry point accepts either spelling.
 """
 
 from __future__ import annotations
@@ -77,6 +82,44 @@ class BF16Compressor(Compressor):
         return tensor.astype(ctx) if ctx is not None else tensor
 
 
+_registry_adapters: dict = {}
+
+
+def _registry_adapter(scheme):
+    """Wrap a registry Scheme as a stateless Compressor: ``compress`` is
+    the scheme's compress-then-decompress roundtrip (the value that would
+    reach the far side of the wire), ``decompress`` the identity.  No
+    error feedback — this is the api.push_pull one-shot path; training
+    loops get EF through DistributedOptimizer / error_feedback_compress.
+    Seeded schemes draw their key from ``BYTEPS_COMPRESSION_SEED`` (fixed
+    per call site — deterministic, documented in docs/compression.md)."""
+    cached = _registry_adapters.get(scheme.name)
+    if cached is not None:
+        return cached
+
+    class RegistryCompressor(Compressor):
+        wire_dtype = None
+
+        @staticmethod
+        def compress(tensor):
+            from ..common.config import get_config
+
+            cfg = get_config()
+            key = (jax.random.PRNGKey(cfg.compression_seed)
+                   if scheme.seeded else None)
+            return scheme.roundtrip(tensor, key=key,
+                                    ratio=cfg.compression_ratio), None
+
+        @staticmethod
+        def decompress(tensor, ctx):
+            return tensor
+
+    RegistryCompressor.__name__ = f"{scheme.name.capitalize()}Compressor"
+    RegistryCompressor.scheme = scheme
+    _registry_adapters[scheme.name] = RegistryCompressor
+    return RegistryCompressor
+
+
 class Compression:
     """Optional gradient compression algorithm used during push_pull
     (reference compression.py:69-75)."""
@@ -84,3 +127,17 @@ class Compression:
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+
+    @classmethod
+    def resolve(cls, spec):
+        """Accept a Compressor class (reference spelling), a registry
+        scheme name (``"onebit"``, ``"topk"``, ...), or None."""
+        if spec is None:
+            return cls.none
+        if isinstance(spec, str):
+            if spec in ("none", "fp16", "bf16"):
+                return getattr(cls, spec)
+            from ..compression import get_scheme
+
+            return _registry_adapter(get_scheme(spec))
+        return spec

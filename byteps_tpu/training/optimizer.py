@@ -32,6 +32,37 @@ class PushPullState(NamedTuple):
     """No dynamic state; the bucket plan is trace-time static."""
 
 
+def resolve_compression(compression):
+    """Split a compression spec into ``(cast_compressor, ef_tx)``.
+
+    Cast specs (Compressor classes, ``"none"``/``"bf16"``/``"fp16"``)
+    ride the collective's ``wire_dtype`` hook unchanged.  Biased registry
+    schemes (``"onebit"``/``"topk"``/``"randomk"``/``"int8"``) become an
+    ``error_feedback_compress`` transformation chained BEFORE the
+    communication — compress after local aggregation, before the wire —
+    with the residual living in the optimizer state (donated,
+    checkpointable; compression/error_feedback.py).
+    """
+    if compression is None:
+        return Compression.none, None
+    if isinstance(compression, str):
+        from ..compression import error_feedback_compress, get_scheme
+
+        scheme = get_scheme(compression)
+        if scheme.name in ("none", "bf16", "fp16"):
+            return getattr(Compression, scheme.name), None
+        return Compression.none, error_feedback_compress(scheme)
+    # a registry adapter class (ops.compression.Compression.resolve) carries
+    # its Scheme: route biased ones to EF exactly like their string
+    # spelling — the cast path would silently ignore them (wire_dtype=None)
+    scheme = getattr(compression, "scheme", None)
+    if scheme is not None and scheme.biased:
+        from ..compression import error_feedback_compress
+
+        return Compression.none, error_feedback_compress(scheme)
+    return compression, None
+
+
 def push_pull_gradients(
     axis_name: Union[str, Sequence[str], None] = "dp",
     average: bool = True,
@@ -48,7 +79,22 @@ def push_pull_gradients(
     shard, reference SURVEY.md §2.4 3-level reduction).
     ``axis_name=None`` means single-worker: pass-through (the reference
     likewise short-circuits when size()==1).
+
+    ``compression`` accepts cast specs only (class or ``"bf16"``/
+    ``"fp16"``); a biased registry scheme needs error-feedback state,
+    which this stateless transformation cannot hold — use
+    ``DistributedOptimizer(compression="onebit")`` or chain
+    ``compression.error_feedback_compress`` in front.
     """
+    if isinstance(compression, str):
+        cast, ef = resolve_compression(compression)
+        if ef is not None:
+            raise ValueError(
+                f"compression={compression!r} is a biased scheme and needs "
+                "error-feedback state; use DistributedOptimizer or chain "
+                "byteps_tpu.compression.error_feedback_compress before "
+                "push_pull_gradients")
+        compression = cast
     cfg = get_config()
     pb = partition_bytes or cfg.effective_partition_bytes
     # compression class wins; else env BYTEPS_WIRE_DTYPE ("bf16"/"fp16")
@@ -90,7 +136,7 @@ def push_pull_gradients(
 def DistributedOptimizer(
     optimizer: optax.GradientTransformation,
     named_parameters: Any = None,  # accepted for API parity; unused in JAX
-    compression: type = Compression.none,
+    compression: Any = Compression.none,  # Compressor class or scheme name
     backward_passes_per_step: int = 1,
     axis_name: Union[str, Sequence[str], None] = "dp",
     average: bool = True,
@@ -100,22 +146,32 @@ def DistributedOptimizer(
     """Wrap an optax optimizer so its gradients are push_pulled across
     workers first (reference torch/__init__.py:383-402 factory).
 
+    ``compression`` takes a Compressor class or a registry scheme name
+    (docs/compression.md): ``"bf16"``/``"fp16"`` cast the collective
+    payload, while ``"onebit"``/``"topk"``/``"randomk"``/``"int8"``
+    chain an error-feedback compressor in front of the allreduce (one
+    extra chain level in the opt_state, holding the fp32 residual
+    pytree).
+
     Usage inside a shard_mapped train step::
 
-        opt = bps.DistributedOptimizer(optax.sgd(0.1), axis_name="dp")
+        opt = bps.DistributedOptimizer(optax.sgd(0.1), axis_name="dp",
+                                       compression="onebit")
         updates, opt_state = opt.update(grads, opt_state, params)
     """
     del named_parameters
-    tx = optax.chain(
+    cast, ef_tx = resolve_compression(compression)
+    links = [] if ef_tx is None else [ef_tx]
+    links.append(
         push_pull_gradients(
             axis_name=axis_name,
             average=average,
-            compression=compression,
+            compression=cast,
             partition_bytes=partition_bytes,
             plan=plan,
-        ),
-        optimizer,
-    )
+        ))
+    links.append(optimizer)
+    tx = optax.chain(*links)
     if backward_passes_per_step > 1:
         tx = optax.MultiSteps(tx, every_k_schedule=backward_passes_per_step)
     return tx

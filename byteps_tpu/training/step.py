@@ -49,12 +49,59 @@ def create_train_state(
     )
 
 
+def _world1_compression_tx(compression) -> Optional[optax.GradientTransformation]:
+    """The single-process rendering of a compression spec: a local optax
+    transformation reproducing what the scheme does to each worker's
+    contribution on a multi-worker wire, so ``world == 1`` sees the same
+    gradient numerics as a multi-process run (the world==1 limit of
+    "compress, reduce over one worker, decompress").
+
+    Returns None when nothing needs doing (no/none compression) or — with
+    a warning — when the spec is genuinely inapplicable: an object that
+    is neither a registry scheme name nor a ``compress``/``decompress``
+    Compressor, whose wire behavior we cannot reproduce locally.
+    """
+    from ..ops.compression import Compression as C
+
+    if compression is None or compression is C.none or compression == "none":
+        return None
+    if isinstance(compression, str):
+        from ..compression import (compression_roundtrip,
+                                   error_feedback_compress, get_scheme)
+
+        scheme = get_scheme(compression)  # unknown names fail like multi
+        if scheme.biased:
+            return error_feedback_compress(scheme)
+        return compression_roundtrip(scheme)
+    if hasattr(compression, "compress") and hasattr(compression,
+                                                    "decompress"):
+        def update_fn(updates, state, params=None):
+            del params
+
+            def one(g):
+                c, ctx = compression.compress(g)
+                return compression.decompress(c, ctx)
+
+            return jax.tree_util.tree_map(one, updates), state
+
+        return optax.GradientTransformation(
+            lambda params: optax.EmptyState(), update_fn)
+    from ..common.logging import get_logger
+
+    get_logger().warning(
+        "make_data_parallel_step: world size is 1 and compression=%r is "
+        "neither a registry scheme name nor a Compressor — it cannot be "
+        "applied locally and is dropped; multi-device meshes will reject "
+        "it too", compression)
+    return None
+
+
 def make_data_parallel_step(
     loss_fn: Callable,
     optimizer: optax.GradientTransformation,
     mesh: Mesh,
     axes: Sequence[str] = ("dp",),
-    compression: type = Compression.none,
+    compression: Any = Compression.none,  # Compressor class or scheme name
     partition_bytes: Optional[int] = None,
     backward_passes_per_step: int = 1,
     donate: bool = True,
@@ -74,12 +121,14 @@ def make_data_parallel_step(
     across replicas so the state stays replicated.
 
     .. note:: At ``world == 1`` (with ``backward_passes_per_step == 1``)
-       the DistributedOptimizer wrapper is dropped entirely — including
-       any ``compression`` passed — matching the reference's ``size()==1``
-       short-circuit.  This changes the ``opt_state`` pytree nesting by
-       one chain-tuple level, so **checkpoints do not transfer between
-       world sizes**; a passed compression triggers a one-time warning
-       since it will not be applied.
+       the DistributedOptimizer wrapper is dropped — matching the
+       reference's ``size()==1`` short-circuit — but any ``compression``
+       passed is still honored through an equivalent local
+       transformation (cast roundtrip, or error-feedback compression for
+       biased registry schemes), so single- and multi-process runs see
+       the same gradient numerics.  The ``opt_state`` pytree nesting
+       still differs from the multi-worker chain, so **checkpoints do
+       not transfer between world sizes**.
     """
     axes = tuple(axes)
     world = 1
@@ -90,16 +139,12 @@ def make_data_parallel_step(
         # when size()==1): the push_pull wrapper is already a traced no-op
         # at world==1, but its chain nesting in opt_state costs measurable
         # per-call dispatch on small models (~80 us/step through the
-        # tunneled runtime) — drop the wrapper entirely.
-        if compression is not Compression.none:
-            from ..common.logging import get_logger
-
-            get_logger().warning(
-                "make_data_parallel_step: world size is 1 — the "
-                "compression=%s wrapper is dropped (nothing crosses the "
-                "wire); it will engage on multi-device meshes",
-                getattr(compression, "__name__", compression))
-        tx = optimizer
+        # tunneled runtime) — drop the wrapper, keep the compression
+        # numerics (a compressed multi-worker run and its single-worker
+        # debug rerun must not silently diverge).
+        comp_tx = _world1_compression_tx(compression)
+        tx = optimizer if comp_tx is None else optax.chain(comp_tx,
+                                                           optimizer)
     else:
         tx = DistributedOptimizer(
             optimizer,

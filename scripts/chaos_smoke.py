@@ -10,11 +10,21 @@ Asserts the final pulled parameters are bit-for-bit identical: every
 dropped request was resent, every applied-but-unacknowledged mutation
 was deduplicated by the version guard, nothing was double-applied.
 
+With ``--compression SCHEME`` (e.g. ``randomk``, ``onebit``) the same
+loop runs with wire compression + error feedback: bit-for-bit parity
+then additionally proves that a retried compressed PUSH never
+double-folds the EF residual — a double-fold (or a replayed random-k
+mask drawn differently) would diverge the chaos run from the clean one
+on the first faulted step (docs/compression.md, "Exactly-once
+interaction").
+
 Usage:
     python scripts/chaos_smoke.py [--steps 60] [--seed 0] [--rate 0.15]
+                                  [--compression randomk]
 
-Wired into CI as a ``slow``-marked pytest (tests/test_chaos_smoke.py)
-so tier-1 stays fast.
+Wired into CI as ``slow``-marked pytests (tests/test_chaos_smoke.py —
+the compressed variant runs at a >=25% injected fault rate) so tier-1
+stays fast.
 """
 
 from __future__ import annotations
@@ -29,7 +39,9 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
 def run(steps: int = 60, seed: int = 0, rate: float = 0.15,
-        dim: int = 16, verbose: bool = True) -> dict:
+        dim: int = 16, verbose: bool = True,
+        compression: str = "") -> dict:
+    from byteps_tpu.compression import CompressionPolicy
     from byteps_tpu.engine import ps_server
     from byteps_tpu.resilience import (FaultInjectingProxy,
                                        ResilienceCounters, RetryPolicy)
@@ -39,6 +51,11 @@ def run(steps: int = 60, seed: int = 0, rate: float = 0.15,
               for i, n in enumerate(names)}
     policy = RetryPolicy(max_attempts=6, backoff_base=0.01,
                          backoff_mult=2.0, jitter=0.0, deadline=30.0)
+    # compress every tensor regardless of size (the smoke tensors are
+    # tiny); generous ratio so the loop still converges in few steps
+    comp = (CompressionPolicy(default=compression, min_bytes=1, ratio=0.25,
+                              seed=seed)
+            if compression else None)
 
     def train(store):
         state = {n: np.zeros(dim, np.float32) for n in names}
@@ -58,7 +75,7 @@ def run(steps: int = 60, seed: int = 0, rate: float = 0.15,
     # ---- clean run -----------------------------------------------------
     servers = [spawn() for _ in range(2)]
     store = ps_server.RemoteStore([a for _, a in servers],
-                                  retry_policy=policy)
+                                  retry_policy=policy, compression=comp)
     clean = train(store)
     store.close()
     for srv, _ in servers:
@@ -75,7 +92,8 @@ def run(steps: int = 60, seed: int = 0, rate: float = 0.15,
                     garble=rate / 3)
     counters = ResilienceCounters()
     store = ps_server.RemoteStore([p.addr for p in proxies],
-                                  retry_policy=policy, counters=counters)
+                                  retry_policy=policy, counters=counters,
+                                  compression=comp)
     chaos = train(store)
     stats = {
         "requests": sum(p.requests_seen for p in proxies),
@@ -99,9 +117,10 @@ def run(steps: int = 60, seed: int = 0, rate: float = 0.15,
             "no faults were injected — raise --rate or --steps, the run "
             "proved nothing")
     if verbose:
-        print(f"chaos smoke OK: {steps} steps x {len(names)} tensors, "
-              f"{stats['faults']}/{stats['requests']} requests faulted, "
-              f"bit-for-bit parameter match")
+        mode = f" [compression={compression}]" if compression else ""
+        print(f"chaos smoke OK{mode}: {steps} steps x {len(names)} "
+              f"tensors, {stats['faults']}/{stats['requests']} requests "
+              f"faulted, bit-for-bit parameter match")
         for k, v in sorted(stats.items()):
             print(f"  {k}: {v}")
     return stats
@@ -112,8 +131,12 @@ def main() -> int:
     ap.add_argument("--steps", type=int, default=60)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--rate", type=float, default=0.15)
+    ap.add_argument("--compression", type=str, default="",
+                    help="wire scheme for a compressed-mode run "
+                         "(onebit/randomk/topk/int8/bf16/fp16)")
     args = ap.parse_args()
-    run(steps=args.steps, seed=args.seed, rate=args.rate)
+    run(steps=args.steps, seed=args.seed, rate=args.rate,
+        compression=args.compression)
     return 0
 
 

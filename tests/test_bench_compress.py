@@ -1,0 +1,37 @@
+"""CI wiring for bench_compress.py (slow bucket, like test_chaos_smoke):
+the acceptance-criteria numbers must hold on the measured wire path —
+>=4x byte reduction for onebit/topk vs the bf16 baseline, with loss
+parity on the small-transformer training leg.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+@pytest.mark.slow
+def test_bench_compress_reduction_and_parity(tmp_path):
+    import bench_compress
+
+    result = bench_compress.run(steps=30, sweeps=2,
+                                out_path=str(tmp_path / "BENCH.json"))
+
+    wire = result["wire"]
+    # acceptance: >=4x fewer measured wire bytes than the bf16 cast
+    assert wire["onebit"]["reduction_vs_bf16"] >= 4.0, wire["onebit"]
+    assert wire["topk"]["reduction_vs_bf16"] >= 4.0, wire["topk"]
+    assert wire["randomk"]["reduction_vs_bf16"] >= 4.0, wire["randomk"]
+    # sanity: the cast halves fp32 exactly (modulo frame headers)
+    assert 1.9 < wire["bf16"]["reduction_vs_raw"] <= 2.1
+
+    parity = result["parity"]
+    for scheme in ("bf16", "onebit", "topk"):
+        r = parity[scheme]
+        # loss-parity within tolerance: the compressed run achieves at
+        # least 70% of the uncompressed loss drop and ends within 0.1
+        # nats of it (EF is what makes this hold for onebit/topk)
+        assert r["progress_vs_none"] >= 0.7, (scheme, r)
+        assert r["final_gap_vs_none"] <= 0.1, (scheme, r)

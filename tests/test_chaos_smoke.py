@@ -23,3 +23,25 @@ def test_chaos_smoke_bitwise_convergence():
     # least once across 160 mutating requests at a 5% drop_after rate —
     # if not, the seed changed the mix; bump steps rather than ignore
     assert stats.get("resilience.retry", 0) > 0
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("scheme", ["randomk", "onebit"])
+def test_chaos_smoke_compressed_exactly_once(scheme):
+    """Acceptance criterion (docs/compression.md): at a >=25% injected
+    fault rate, a retried compressed PUSH must never double-apply the
+    error-feedback residual — chaos.run raises on any clean/chaos
+    divergence, and with EF compression a single double-fold (or a
+    re-drawn random-k mask) diverges immediately.  Run twice with the
+    same seed to pin run-reproducibility."""
+    import chaos_smoke
+
+    stats1 = chaos_smoke.run(steps=40, seed=1, rate=0.27, verbose=False,
+                             compression=scheme)
+    assert stats1["faults"] > 0
+    assert stats1["faults"] / stats1["requests"] >= 0.05
+    assert stats1.get("resilience.retry", 0) > 0
+    stats2 = chaos_smoke.run(steps=40, seed=1, rate=0.27, verbose=False,
+                             compression=scheme)
+    # seeded faults + seeded compression => identical fault/retry mix
+    assert stats2["faults"] == stats1["faults"]
