@@ -16,7 +16,12 @@ single-chip bench.py cannot:
   * **jit bucket order** — the same DP step with the BucketPlan's
     schedule_order reversed, showing the traced path's order sensitivity
     (XLA owns the final schedule there; the eager path is where runtime
-    order matters — this line quantifies both honestly).
+    order matters — this line quantifies both honestly);
+  * **pipelined wire** (PR 4, docs/wire.md) — serial vs windowed
+    ``RemoteStore.push_pull`` against 4 real PS shard processes with
+    a >=4-partition tensor, on raw loopback AND on an emulated
+    5 ms/hop wire; archived into BENCH_COMM.json
+    (``--wire-only`` runs just this A/B).
 
 Prints ONE JSON line per point.  Runs anywhere (CPU virtual mesh by
 construction):  python bench_comm.py [--layers 8 --dim 1024]
@@ -26,12 +31,25 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 
-import jax
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    # newer jax spells the device-count override as a config option; on
+    # older versions the XLA_FLAGS env set above applies as long as no
+    # backend has been initialized yet (same dance as tests/conftest.py)
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass
 
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
@@ -261,6 +279,158 @@ def jit_bucket_order(mesh, layers, dim, iters):
     return res
 
 
+def pipelined_wire(mb=8, part_kb=1024, shards=4, delay_ms=5.0, reps=8,
+                   archive=True):
+    """Serial vs pipelined ``RemoteStore.push_pull`` (PR 4, docs/wire.md):
+    4 real PS shard *processes*, one tensor split into >=4 partitions,
+    measured interleaved (serial/pipelined alternating, min + median) so
+    ambient load cancels.  Two rows:
+
+      * raw loopback — honest but CPU-bound on small hosts: client and
+        servers share the cores, so the overlap the window buys is
+        whatever idle the serial path actually had;
+      * emulated 5 ms/hop wire (protocol-aware FaultInjectingProxy
+        ``delay`` on every request) — the latency-dominated regime the
+        architecture targets.  The proxy serializes its delays per
+        connection, which UNDERSTATES pipelining vs a real link (real
+        in-flight frames overlap their latencies), so the measured
+        speedup is a lower bound.
+    """
+    import dataclasses
+    import statistics
+    import subprocess
+    import sys as _sys
+
+    from byteps_tpu.common.config import get_config, set_config
+    from byteps_tpu.engine import ps_server
+    from byteps_tpu.resilience import FaultInjectingProxy
+
+    def free_port():
+        import socket as _socket
+
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    def wait_port(p):
+        import socket as _socket
+
+        for _ in range(150):
+            try:
+                _socket.create_connection(("127.0.0.1", p),
+                                          timeout=0.2).close()
+                return
+            except OSError:
+                time.sleep(0.2)
+        raise RuntimeError(f"PS shard on :{p} never came up")
+
+    ports = [free_port() for _ in range(shards)]
+    procs = []
+    rows = []
+    saved_cfg = get_config()
+    try:
+        for p in ports:  # spawn INSIDE the try: a failed spawn must not
+            procs.append(subprocess.Popen(  # leak earlier shards
+                [_sys.executable, "-c",
+                 f"from byteps_tpu.engine import ps_server; "
+                 f"ps_server.serve({p}, host='127.0.0.1', "
+                 f"use_native=False)"],
+                env={**os.environ, "JAX_PLATFORMS": "cpu"}))
+        for p in ports:
+            wait_port(p)
+        # replace(), not a fresh Config: env-derived knobs (e.g.
+        # BYTEPS_WIRE_WINDOW under test) must keep applying
+        set_config(dataclasses.replace(saved_cfg,
+                                       partition_bytes=part_kb * 1024))
+        x = np.ones(mb * 1024 * 1024 // 4, np.float32)
+        nparts = max(1, mb * 1024 // part_kb)
+
+        def measure(addrs, tag):
+            stores = {
+                "serial": ps_server.RemoteStore(addrs, wire_window=0),
+                "pipelined": ps_server.RemoteStore(addrs),
+            }
+            for mode, st in stores.items():
+                st.init_tensor(f"{tag}_{mode}", np.zeros_like(x))
+                st.push_pull(f"{tag}_{mode}", x)  # warm the path
+            t = {m: [] for m in stores}
+            for _ in range(reps):  # interleaved: load hits both alike
+                for mode, st in stores.items():
+                    t0 = time.perf_counter()
+                    st.push_pull(f"{tag}_{mode}", x)
+                    t[mode].append(time.perf_counter() - t0)
+            for st in stores.values():
+                st.close()
+            return t
+
+        direct = measure([f"127.0.0.1:{p}" for p in ports], "raw")
+        proxies = [FaultInjectingProxy(f"127.0.0.1:{p}", seed=i)
+                   for i, p in enumerate(ports)]
+        for px in proxies:
+            px.set_rates(delay=delay_ms / 1e3)
+        try:
+            lat = measure([px.addr for px in proxies], "lat")
+        finally:
+            for px in proxies:
+                px.close()
+
+        for metric, t, wire in (
+                ("pipelined_wire_push_pull_ms", direct, "raw loopback"),
+                (f"pipelined_wire_{delay_ms:g}ms_hop_ms", lat,
+                 f"emulated {delay_ms:g}ms/hop (proxy; conservative)")):
+            row = {
+                "metric": metric,
+                "value": round(min(t["pipelined"]) * 1e3, 2),
+                "unit": "ms/push_pull",
+                "serial_ms": round(min(t["serial"]) * 1e3, 2),
+                "speedup_min": round(min(t["serial"])
+                                     / min(t["pipelined"]), 3),
+                "speedup_median": round(
+                    statistics.median(t["serial"])
+                    / statistics.median(t["pipelined"]), 3),
+                "shards": shards,
+                "parts": nparts,
+                "tensor_mb": mb,
+                "wire": wire,
+                "window": get_config().wire_window,
+                "tool": "bench_comm.py",
+            }
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+    finally:
+        set_config(saved_cfg)
+        for pr in procs:
+            pr.terminate()
+        for pr in procs:  # reap, don't zombie through the rest of main()
+            try:
+                pr.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pr.kill()
+                pr.wait(timeout=5)
+    if archive and rows:
+        _archive_rows(rows)
+    return rows
+
+
+def _archive_rows(rows, path="BENCH_COMM.json"):
+    """Merge rows into BENCH_COMM.json by metric name (acceptance
+    artifact: the pipelined-wire numbers live next to the PR-4-era
+    comm matrix)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        doc = {"rows": []}
+    new_metrics = {r["metric"] for r in rows}
+    doc["rows"] = [r for r in doc.get("rows", [])
+                   if r.get("metric") not in new_metrics] + rows
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(f"archived {len(rows)} rows -> {path}", flush=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--layers", type=int, default=8)
@@ -269,7 +439,21 @@ def main():
     ap.add_argument("--eager-tensors", type=int, default=12)
     ap.add_argument("--eager-mbytes", type=int, default=8)
     ap.add_argument("--eager-iters", type=int, default=3)
+    ap.add_argument("--wire-mb", type=int, default=8)
+    ap.add_argument("--wire-part-kb", type=int, default=1024)
+    ap.add_argument("--wire-delay-ms", type=float, default=5.0)
+    ap.add_argument("--wire-reps", type=int, default=8)
+    ap.add_argument("--wire-only", action="store_true",
+                    help="run only the pipelined-wire A/B")
+    ap.add_argument("--no-archive", action="store_true",
+                    help="do not update BENCH_COMM.json")
     args = ap.parse_args()
+
+    pipelined_wire(mb=args.wire_mb, part_kb=args.wire_part_kb,
+                   delay_ms=args.wire_delay_ms, reps=args.wire_reps,
+                   archive=not args.no_archive)
+    if args.wire_only:
+        return
 
     from byteps_tpu.parallel.mesh import build_mesh
 

@@ -136,6 +136,12 @@ def shutdown() -> None:
         _state.mesh = None
         _state.reduce_axes = []
         _state.initialized = False
+        # release the process-default async-PS store (its wire workers
+        # and heartbeat are live threads — engine/async_ps owns the
+        # swap-then-close lifecycle)
+        from .engine.async_ps import close_async_store
+
+        close_async_store()
         from .common.tracing import reset_tracer
 
         reset_tracer()  # flushes the chrome trace if enabled

@@ -144,6 +144,16 @@ class Config:
     serve_model: str = ""         # "k=v,..." TransformerConfig overrides
     serve_checkpoint: str = ""    # params checkpoint for the serve role
 
+    # --- pipelined wire engine (byteps_tpu/engine/wire.py; the client
+    # half of the push/pull pipelining BytePS keeps the wire busy with —
+    # docs/wire.md) -------------------------------------------------------
+    # in-flight request window per shard connection; 0 = serial legacy
+    # client (one blocking round-trip at a time — the A/B baseline)
+    wire_window: int = 8
+    # part-level fan-out concurrency of RemoteStore (threads gathering
+    # partition futures; also bounds concurrent compression encodes)
+    wire_fanout: int = 16
+
     # --- gradient wire compression (byteps_tpu/compression/; the
     # reference reserved kCompressedPushPull, common.h:212-216, and never
     # implemented it — docs/compression.md) ------------------------------
@@ -202,6 +212,8 @@ class Config:
             serve_eos_id=_env_opt_int("BYTEPS_SERVE_EOS_ID"),
             serve_model=_env_str("BYTEPS_SERVE_MODEL", ""),
             serve_checkpoint=_env_str("BYTEPS_SERVE_CHECKPOINT", ""),
+            wire_window=_env_int("BYTEPS_WIRE_WINDOW", 8),
+            wire_fanout=_env_int("BYTEPS_WIRE_FANOUT", 16),
             compression=_env_str("BYTEPS_COMPRESSION", ""),
             compression_min_bytes=_env_int("BYTEPS_MIN_COMPRESS_BYTES", 1024),
             compression_overrides=_env_str(

@@ -42,6 +42,7 @@ class ScheduledQueue:
         self._queue: List[TensorTaskEntry] = []
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
+        self._closed = False
         self.name = name
 
     def add_task(self, task: TensorTaskEntry) -> None:
@@ -90,12 +91,17 @@ class ScheduledQueue:
 
     def wait_task(self, timeout: Optional[float] = None) -> Optional[TensorTaskEntry]:
         """Blocking get — condition-variable driven instead of the
-        reference's 1 microsecond poll-sleep (core_loops.cc:130)."""
+        reference's 1 microsecond poll-sleep (core_loops.cc:130).
+        Returns None immediately once the queue is ``close()``d (after
+        draining nothing further arrives), so consumer loops need no
+        poison task to exit."""
         with self._cv:
             while True:
                 task = self._get_locked()
                 if task is not None:
                     return task
+                if self._closed:
+                    return None
                 if not self._cv.wait(timeout):
                     return None
 
@@ -110,6 +116,16 @@ class ScheduledQueue:
             del self._queue[i]
             return task
         return None
+
+    def close(self) -> None:
+        """Wake every ``wait_task`` waiter and make future waits return
+        None at once.  ``add_task`` after close still enqueues (the task
+        will never be granted by ``wait_task`` — callers that must fail
+        such tasks loudly ``drain()`` after close); this keeps shutdown
+        races benign instead of raising into producer threads."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
 
     def drain(self) -> List[TensorTaskEntry]:
         """Remove and return every queued task, ignoring readiness and

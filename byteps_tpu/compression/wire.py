@@ -49,21 +49,41 @@ WIRE_TAG = "bpsc1"  # current version; bump on any layout change
 
 
 class WireBlob:
-    """A compressed tensor ready for the wire: ``engine/ps_server._encode``
-    sends ``data`` as the frame payload under the ``bpsc1`` dtype tag with
-    the original ``shape`` in the frame header."""
+    """A compressed tensor ready for the wire: the frame codec
+    (``engine/wire._encode_buffers``) sends it as the frame payload under
+    the ``bpsc1`` dtype tag with the original ``shape`` in the frame
+    header.
 
-    __slots__ = ("shape", "data", "raw_nbytes")
+    The payload is held as a *list of buffers* (blob header / scheme
+    data) so the scatter-gather send path never concatenates the scheme
+    bytes into a second copy; ``data`` joins them lazily for one-shot
+    consumers (tests, the serial client's ping path)."""
 
-    def __init__(self, shape: Tuple[int, ...], data: bytes,
+    __slots__ = ("shape", "_bufs", "raw_nbytes")
+
+    def __init__(self, shape: Tuple[int, ...], data,
                  raw_nbytes: int = 0):
         self.shape = tuple(shape)
-        self.data = data
+        if isinstance(data, (bytes, bytearray, memoryview)):
+            self._bufs = [data]
+        else:
+            self._bufs = list(data)
         self.raw_nbytes = raw_nbytes
+
+    def buffers(self) -> list:
+        """The payload as buffers for ``sendmsg`` scatter-gather."""
+        return list(self._bufs)
+
+    @property
+    def data(self) -> bytes:
+        """The payload as one contiguous bytes (joined + cached)."""
+        if len(self._bufs) != 1 or not isinstance(self._bufs[0], bytes):
+            self._bufs = [b"".join(bytes(b) for b in self._bufs)]
+        return self._bufs[0]
 
     @property
     def nbytes(self) -> int:
-        return len(self.data)
+        return sum(memoryview(b).nbytes for b in self._bufs)
 
 
 def encode_blob(scheme: Scheme, arr: np.ndarray, seed: int = 0,
@@ -78,13 +98,16 @@ def encode_blob(scheme: Scheme, arr: np.ndarray, seed: int = 0,
     ctx, data = scheme.wire_encode(xf, seed=seed, ratio=ratio)
     sname = scheme.name.encode()
     dtname = np.dtype(arr.dtype).name.encode()
-    blob = (struct.pack("<B", len(sname)) + sname
+    # blob header and scheme data stay separate buffers: the wire layer
+    # scatter-gathers them, so the (potentially large) data bytes are
+    # never copied into a concatenation
+    head = (struct.pack("<B", len(sname)) + sname
             + struct.pack("<B", len(dtname)) + dtname
             + struct.pack("<I", len(ctx)) + ctx
-            + struct.pack("<Q", len(data)) + data)
+            + struct.pack("<Q", len(data)))
     deq = (scheme.wire_decode(ctx, data, xf.size).reshape(arr.shape)
            if with_deq else None)
-    return WireBlob(arr.shape, blob, arr.nbytes), deq
+    return WireBlob(arr.shape, [head, data], arr.nbytes), deq
 
 
 def decode_blob(tag: str, payload: bytes, shape) -> np.ndarray:

@@ -257,6 +257,23 @@ def reset_async_store() -> None:
     set_async_store(None)
 
 
+def close_async_store() -> None:
+    """Atomically detach AND close the process-default store.  A
+    ``RemoteStore`` owns per-shard wire workers (engine/wire.py) and a
+    heartbeat thread; dropping the reference without ``close()`` leaks
+    live threads pointed at possibly-dead servers.  Swap-then-close
+    under the lock so a concurrent ``get_async_store`` either sees the
+    old (still-open) store or builds a fresh one — never a closed one."""
+    global _default_store
+    with _default_store_lock:
+        store, _default_store = _default_store, None
+    if store is not None and hasattr(store, "close"):
+        try:
+            store.close()
+        except Exception as e:  # never mask shutdown on a dead server
+            bps_log.debug("async store close: %s", e)
+
+
 def _server_addrs_from_env() -> List[str]:
     """Worker-side server discovery: explicit ``BYTEPS_SERVER_ADDRS``
     ("host:port,host:port"), else derived from the DMLC contract the way the

@@ -187,8 +187,10 @@ class Engine:
 
     def shutdown(self) -> None:
         self._shutdown.set()
-        # wake the dispatcher with a poison add
-        self.queue.add_task(TensorTaskEntry(name="__poison__", key=-1, length=0))
+        # close() wakes the dispatcher's wait_task (it returns None once
+        # closed) — no poison task needed; the wire workers' send loops
+        # use the same mechanism (common/scheduler.py)
+        self.queue.close()
         self._completion_q.put(None)
         self._dispatcher.join(timeout=5.0)
         for t in self._completers:
@@ -204,9 +206,7 @@ class Engine:
         while not self._shutdown.is_set():
             task = self.queue.wait_task(timeout=0.25)
             if task is None:
-                continue
-            if task.name == "__poison__":
-                break
+                continue  # timeout or queue closed; the while re-checks
             try:
                 with tracer.span(task.name, "dispatch", key=task.key,
                                  bytes=task.length):

@@ -57,6 +57,14 @@ fails loudly on the unknown dtype name — never a silent misread.
 ``BYTEPS_PARTITION_BYTES`` into independently keyed ``name#p{i}`` parts
 (reference PartitionTensor, operations.cc:95-132) so compression,
 version-guarded retries and shard placement all happen per partition.
+
+Pipelined client (byteps_tpu/engine/wire.py — docs/wire.md): with
+``BYTEPS_WIRE_WINDOW`` > 0 (default 8) every shard gets a send/receive
+I/O worker with a bounded in-flight request window and FIFO reply
+matching, and multi-partition ops fan their parts out concurrently
+across shards in ``ScheduledQueue`` priority order — the client half of
+the paper's keep-the-wire-busy architecture.  ``BYTEPS_WIRE_WINDOW=0``
+restores the serial one-frame-in-flight client (the A/B baseline).
 """
 
 from __future__ import annotations
@@ -72,111 +80,17 @@ import numpy as np
 
 from ..common import logging as bps_log
 from ..common.context import name_key
-from ..compression.wire import WIRE_MAGIC, WireBlob, decode_blob
+from ..compression.wire import WireBlob  # noqa: F401  (re-export compat)
 from .async_ps import AsyncParameterServer
+# framing codec + pipeline live in engine/wire.py; re-exported here
+# because the chaos proxy, the serving frontend and tests import them
+# from this module (one wire framing, one reader)
+from .wire import (ShardWorker, _decode, _dtype_to_wire,  # noqa: F401
+                   _encode, _encode_buffers, _recv_exact, _send_buffers,
+                   _wire_to_dtype, hard_reset)
 
 (OP_INIT, OP_PUSH_PULL, OP_PULL, OP_VERSION, OP_NAMES, OP_PING, OP_PUSH,
  OP_SET) = range(8)
-_MAX_NAME = 1 << 16
-_MAX_PAYLOAD = 1 << 34  # 16 GiB sanity bound
-
-
-# ---------------------------------------------------------------- wire codec
-
-
-def _dtype_to_wire(dt: np.dtype) -> bytes:
-    """Encode a dtype by *name* (e.g. ``bfloat16``): ml_dtypes dtypes have
-    ``.str`` of ``'<V2'`` (raw void) which would not round-trip."""
-    return np.dtype(dt).name.encode()
-
-
-def _wire_to_dtype(name: str) -> np.dtype:
-    try:
-        return np.dtype(name)
-    except TypeError:
-        import ml_dtypes
-
-        return np.dtype(getattr(ml_dtypes, name))
-
-
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(min(n - len(buf), 1 << 20))
-        if not chunk:
-            raise ConnectionError("peer closed mid-message")
-        buf += chunk
-    return bytes(buf)
-
-
-def hard_reset(sock: socket.socket) -> None:
-    """Close with an RST (SO_LINGER 0), not a FIN — the peer sees
-    ECONNRESET mid-RPC, the way a crashed process looks.  Shared by
-    ``PSServer.kill`` and the chaos proxy."""
-    try:
-        sock.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
-                        struct.pack("ii", 1, 0))
-    except OSError:
-        pass
-    try:
-        sock.close()
-    except OSError:
-        pass
-
-
-def _encode(op: int, name: str, arr,
-            raw: bytes = b"") -> bytes:
-    nb = name.encode()
-    if isinstance(arr, WireBlob):
-        # compressed payload: versioned dtype tag, original shape in the
-        # frame header, scheme-tagged blob as the payload
-        from ..compression.wire import WIRE_TAG
-
-        dt = WIRE_TAG.encode()
-        shape = arr.shape
-        payload = arr.data
-    elif arr is not None:
-        arr = np.ascontiguousarray(arr)
-        dt = _dtype_to_wire(arr.dtype)
-        shape = arr.shape
-        payload = arr.tobytes()
-    else:
-        dt = b""
-        shape = ()
-        payload = raw
-    head = struct.pack("<BI", op, len(nb)) + nb
-    head += struct.pack("<I", len(dt)) + dt
-    head += struct.pack("<B", len(shape)) + struct.pack(
-        f"<{len(shape)}Q", *shape
-    )
-    head += struct.pack("<Q", len(payload))
-    return head + payload
-
-
-def _decode(sock: socket.socket):
-    op, nlen = struct.unpack("<BI", _recv_exact(sock, 5))
-    if nlen > _MAX_NAME:
-        raise ValueError(f"name too long: {nlen}")
-    name = _recv_exact(sock, nlen).decode()
-    (dlen,) = struct.unpack("<I", _recv_exact(sock, 4))
-    dt = _recv_exact(sock, dlen).decode()
-    (ndim,) = struct.unpack("<B", _recv_exact(sock, 1))
-    shape = struct.unpack(f"<{ndim}Q", _recv_exact(sock, 8 * ndim)) if ndim else ()
-    (plen,) = struct.unpack("<Q", _recv_exact(sock, 8))
-    if plen > _MAX_PAYLOAD:
-        raise ValueError(f"payload too large: {plen}")
-    payload = _recv_exact(sock, plen) if plen else b""
-    arr = None
-    if dt:
-        if dt.startswith(WIRE_MAGIC):
-            # compressed frame: decompress here so both ends of the wire
-            # (server request leg, client reply leg) see a dense array —
-            # version/framing mismatches raise loudly in decode_blob
-            arr = decode_blob(dt, payload, shape)
-        else:
-            arr = np.frombuffer(payload,
-                                dtype=_wire_to_dtype(dt)).reshape(shape)
-    return op, name, arr, payload
 
 
 # -------------------------------------------------------------------- server
@@ -316,6 +230,12 @@ class ServerProfiler:
 
 
 class _Handler(socketserver.BaseRequestHandler):
+    """One connection, many requests — strictly FIFO: each request is
+    fully served and its reply sent before the next is read.  The
+    pipelined client RELIES on this order to match replies to requests
+    without protocol tags (docs/wire.md); a future concurrent-handler
+    server must bump the protocol to tagged frames first."""
+
     def handle(self):  # one connection, many requests
         store: AsyncParameterServer = self.server.store  # type: ignore[attr-defined]
         profiler: Optional[ServerProfiler] = getattr(
@@ -337,6 +257,9 @@ class _Handler(socketserver.BaseRequestHandler):
                 # store-level errors (e.g. pull of an un-init'd name) reply
                 # status=1 and keep the connection alive — only wire-level
                 # failures tear it down
+                # replies are built as buffer lists and sent with
+                # sendmsg scatter-gather: a multi-MB PULL reply goes out
+                # as header + a zero-copy view of the store's array
                 try:
                     if op == OP_INIT:
                         # a first-push-wins LOSER gets the winning value
@@ -352,7 +275,7 @@ class _Handler(socketserver.BaseRequestHandler):
                             if v is None:
                                 v = store.version(name)
                             created = False
-                        reply = _encode(
+                        reply = _encode_buffers(
                             0, str(v),
                             None if created else reply_c(store.pull(name)))
                     elif op == OP_PUSH_PULL:
@@ -365,37 +288,37 @@ class _Handler(socketserver.BaseRequestHandler):
                         else:
                             out = store.push_pull(name, arr)
                             v = store.version(name)
-                        reply = _encode(0, str(v), reply_c(out))
+                        reply = _encode_buffers(0, str(v), reply_c(out))
                     elif op == OP_PUSH:
                         v = store.push_delta(name, arr)
                         if v is None:
                             v = store.version(name)
-                        reply = _encode(0, str(v), None)
+                        reply = _encode_buffers(0, str(v), None)
                     elif op == OP_SET:
                         v = store.set_tensor(name, arr)
                         if v is None:
                             v = store.version(name)
-                        reply = _encode(0, str(v), None)
+                        reply = _encode_buffers(0, str(v), None)
                     elif op == OP_PULL:
-                        reply = _encode(0, "", reply_c(store.pull(name)))
+                        reply = _encode_buffers(0, "", reply_c(store.pull(name)))
                     elif op == OP_VERSION:
-                        reply = _encode(0, "", None,
+                        reply = _encode_buffers(0, "", None,
                                         struct.pack("<Q", store.version(name)))
                     elif op == OP_NAMES:
-                        reply = _encode(0, "", None,
+                        reply = _encode_buffers(0, "", None,
                                         "\n".join(store.names()).encode())
                     elif op == OP_PING:
-                        reply = _encode(0, "", None)
+                        reply = _encode_buffers(0, "", None)
                     else:
-                        reply = _encode(1, "", None, f"bad op {op}".encode())
+                        reply = _encode_buffers(1, "", None, f"bad op {op}".encode())
                 except Exception as e:
-                    reply = _encode(
+                    reply = _encode_buffers(
                         1, "", None, f"{type(e).__name__}: {e}".encode()
                     )
                 if profiler is not None:
                     profiler.record(op, name, peer, t_begin,
                                     time.perf_counter())
-                sock.sendall(reply)
+                _send_buffers(sock, reply)
         except Exception as e:  # pragma: no cover - connection teardown races
             bps_log.debug("ps_server handler exit: %s", e)
         finally:
@@ -534,7 +457,8 @@ class RemoteStore:
 
     def __init__(self, addrs: List[str], use_hash: bool = False,
                  timeout: float = 30.0, retry_policy=None, counters=None,
-                 heartbeat: Optional[float] = None, compression=None):
+                 heartbeat: Optional[float] = None, compression=None,
+                 wire_window: Optional[int] = None):
         from ..common.config import get_config
         from ..common.context import ServerSharder
         from ..compression import (CompressionPolicy, WireCompressor,
@@ -599,6 +523,31 @@ class RemoteStore:
         self._compressor = WireCompressor(policy, stats=self._wire_stats)
         self._partition_bytes = cfg.effective_partition_bytes
         self._part_meta: dict = {}  # base name -> (nparts, shape, dtype)
+        # failover/restart seed cache (_last_global).  Off when the user
+        # disabled BYTEPS_FAILOVER outright: the snapshots exist purely
+        # to re-seed shards, so keeping multi-MB copies of every reply
+        # would be pure overhead (restart re-seeding is then off too).
+        self._seed_enabled = cfg.failover
+        # name -> issue priority (reference tensorflow/ops.cc:158:
+        # earlier-declared = higher priority, so the first-needed tensor
+        # wins the wire under the per-shard ScheduledQueue)
+        self._prio: dict = {}
+        # pipelined wire engine (docs/wire.md): per-shard I/O workers
+        # with a bounded in-flight window; multi-part ops submit up to
+        # _fanout parts ahead of the gather.  window=0 = serial legacy
+        # client (the A/B baseline).
+        self._window = (cfg.wire_window if wire_window is None
+                        else int(wire_window))
+        self._fanout = max(1, cfg.wire_fanout)
+        self._workers: Optional[List[ShardWorker]] = None
+        if self._window > 0:
+            self._workers = [
+                ShardWorker(
+                    (lambda i=i: self._connect(i)), self._window, shard=i,
+                    recv_timeout=self._timeout,
+                    on_reset=(lambda err, n, i=i: self._on_wire_reset(i, n)))
+                for i in range(len(addrs))
+            ]
         self._hb_interval = cfg.heartbeat_interval_ms / 1e3
         self._hb_timeout = cfg.heartbeat_timeout_ms / 1e3
         self._hb_threshold = cfg.heartbeat_miss_threshold
@@ -609,14 +558,122 @@ class RemoteStore:
 
     # ------------------------------------------------ sockets & heartbeat
 
+    def _connect(self, i: int) -> socket.socket:
+        host, port = self._addrs[i].rsplit(":", 1)
+        s = socket.create_connection((host, int(port)),
+                                     timeout=self._timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
     def _sock(self, i: int) -> socket.socket:
         if self._socks[i] is None:
-            host, port = self._addrs[i].rsplit(":", 1)
-            s = socket.create_connection((host, int(port)),
-                                         timeout=self._timeout)
-            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._socks[i] = s
+            self._socks[i] = self._connect(i)
         return self._socks[i]
+
+    def _on_wire_reset(self, shard: int, n_inflight: int) -> None:
+        """ShardWorker connection kill: the pipelined analog of
+        ``_drop_socket_locked`` — same RECONNECT accounting, plus a
+        window-abort count when a whole in-flight window died at once
+        (each of those requests re-enters its own retry machinery)."""
+        self._counters.bump(self._cn.RECONNECT, shard=shard)
+        if n_inflight > 1:
+            self._counters.bump(self._cn.WINDOW_ABORT, shard=shard,
+                                n=1, inflight=n_inflight)
+
+    # -------------------------------------------------- part-level fan-out
+
+    def _submit_part(self, shard: int, op: int, name: str, arr=None,
+                     raw: bytes = b"", priority: int = 0, key: int = 0):
+        """Optimistic pipelined first attempt of one part: issue the
+        frame on the shard worker NOW (it rides the wire while the
+        caller encodes/waits siblings) and hand the future to ``_rpc``
+        as attempt #1.  Returns None when the op must start inside
+        ``_rpc`` instead: serial mode, or the shard is currently routed
+        away (degraded mode must hold the failover lock around I/O)."""
+        if self._workers is None:
+            return None
+        if self._failover_enabled and self._router.route(shard) != shard:
+            return None
+        try:
+            return self._workers[shard].submit(
+                _encode_buffers(op, name, arr, raw), priority=priority,
+                key=key)
+        except ConnectionError:
+            return None
+
+    def _pipeline_parts(self, op: int, parts, encode, prio: int):
+        """Windowed fan-out over the partitions of one logical op: up to
+        ``BYTEPS_WIRE_FANOUT`` parts are encoded + submitted ahead of
+        the one currently being gathered, so compression of part *i+1*
+        and the socket wait of part *i* overlap, and parts fan out
+        across shard connections concurrently (each shard's in-flight
+        window bounds the wire).  ``encode(pname, part) -> (payload,
+        commit)``; each part's ``commit`` (EF residual) fires only after
+        ITS ack, in gather order.  Returns the per-part ``out`` values.
+
+        On a part failure the already-submitted siblings are still
+        awaited (and their residuals committed on success) before the
+        error is re-raised — their mutations may have landed server-side
+        and must not leave the EF state half-updated."""
+        n = len(parts)
+        ahead = max(1, self._fanout)
+        state: dict = {}
+
+        def _issue(j):
+            pname, part = parts[j]
+            payload, commit = encode(pname, part)
+            shard = self._shard_of(pname,
+                                   0 if part is None else part.nbytes)
+            pend = self._submit_part(shard, op, pname, payload,
+                                     priority=prio, key=j)
+            state[j] = (shard, pname, payload, commit, pend)
+
+        outs = [None] * n
+        j = 0
+        try:
+            for i in range(n):
+                while j < n and j < i + ahead:
+                    _issue(j)
+                    j += 1
+                shard, pname, payload, commit, pend = state.pop(i)
+                out, _ = self._rpc(shard, op, pname, payload,
+                                   priority=prio, key=i, pending=pend)
+                if commit is not None:
+                    commit()  # EF residual: after THIS part's own ack
+                outs[i] = out
+        except BaseException:
+            for k in sorted(state):
+                shard, pname, payload, commit, pend = state[k]
+                if pend is None:
+                    continue
+                try:
+                    status, rname, out, _ = self._workers[shard].wait(
+                        pend, self._timeout)
+                    if status == 0:
+                        # a drained sibling's ack is still an ack: record
+                        # its version baseline AND fold it into the
+                        # failover seed (skipping _note_success here
+                        # would falsely dedup the NEXT push of this part
+                        # and let a failover re-seed erase this one)
+                        self._note_success(op, pname, rname, out, payload,
+                                           shard=shard)
+                        if commit is not None:
+                            commit()
+                except Exception:
+                    pass  # best-effort drain; the first error wins
+            raise
+        return outs
+
+    def _priority_of(self, name: str) -> int:
+        """First-touch declaration order -> issue priority (earlier =
+        higher), the reference's convention for "what the next forward
+        needs first"."""
+        with self._state_lock:
+            p = self._prio.get(name)
+            if p is None:
+                p = -len(self._prio)
+                self._prio[name] = p
+            return p
 
     def _drop_socket_locked(self, shard: int) -> None:
         """Drop the (possibly poisoned) cached socket so the next RPC
@@ -665,6 +722,8 @@ class RemoteStore:
     def _on_shard_down(self, shard: int) -> None:
         if self._failover_enabled and self._router.mark_down(shard):
             self._counters.bump(self._cn.FAILOVER, shard=shard)
+        if self._workers is not None:
+            self._workers[shard].drop_connection()
         with self._locks[shard]:
             self._drop_socket_locked(shard)
 
@@ -717,31 +776,50 @@ class RemoteStore:
 
     def _rpc_raw(self, shard: int, op: int, name: str,
                  arr: Optional[np.ndarray] = None, raw: bytes = b"",
-                 op_timeout: Optional[float] = None):
+                 op_timeout: Optional[float] = None, priority: int = 0,
+                 key: int = 0, pending=None):
         """One attempt against one shard; no retry, no routing.
-        ``op_timeout`` clamps the socket timeout for this attempt so a
-        hung shard cannot stall an op past its retry deadline (a blocked
-        read would otherwise wait the full connection timeout)."""
-        with self._locks[shard]:
-            try:
-                sock = self._sock(shard)
-                sock.settimeout(self._timeout if op_timeout is None
-                                else max(0.05, min(self._timeout,
-                                                   op_timeout)))
-                sock.sendall(_encode(op, name, arr, raw))
-                status, rname, out, payload = _decode(sock)
-            except _WIRE_ERRORS:
-                self._drop_socket_locked(shard)
-                raise
+        ``op_timeout`` clamps the wait for this attempt so a hung shard
+        cannot stall an op past its retry deadline.
+
+        Pipelined mode: the frame is enqueued on the shard's I/O worker
+        (issue order = priority desc, key asc) and this thread blocks on
+        its future — up to ``BYTEPS_WIRE_WINDOW`` requests from
+        concurrent callers ride the connection un-acked.  ``pending``
+        (from ``_submit_part``) is an already-issued frame: this attempt
+        then only waits — how multi-part ops overlap their parts.  A
+        wait timeout aborts through the worker (killing the connection —
+        FIFO reply matching cannot skip one frame) and surfaces as the
+        same ``socket.timeout`` the serial path produces."""
+        wait = (self._timeout if op_timeout is None
+                else max(0.05, min(self._timeout, op_timeout)))
+        if self._workers is not None:
+            worker = self._workers[shard]
+            if pending is None:
+                pending = worker.submit(_encode_buffers(op, name, arr, raw),
+                                        priority=priority, key=key)
+            status, rname, out, payload = worker.wait(pending, wait)
+        else:
+            with self._locks[shard]:
+                try:
+                    sock = self._sock(shard)
+                    sock.settimeout(wait)
+                    _send_buffers(sock, _encode_buffers(op, name, arr, raw))
+                    status, rname, out, payload = _decode(sock)
+                except _WIRE_ERRORS:
+                    self._drop_socket_locked(shard)
+                    raise
         if status != 0:
-            raise RuntimeError(f"ps_server error: {payload.decode()!r}")
+            raise RuntimeError(f"ps_server error: {bytes(payload).decode()!r}")
         return rname, out, payload
 
     def _rpc_once(self, shard: int, op: int, name: str,
                   arr: Optional[np.ndarray] = None, raw: bytes = b"",
-                  op_timeout: Optional[float] = None):
+                  op_timeout: Optional[float] = None, priority: int = 0,
+                  key: int = 0, pending=None):
         rname, out, payload = self._rpc_raw(shard, op, name, arr, raw,
-                                            op_timeout)
+                                            op_timeout, priority, key,
+                                            pending)
         if self._detector is not None:
             self._detector.report_success(shard)
         self._note_success(op, name, rname, out, arr, shard=shard)
@@ -755,30 +833,81 @@ class RemoteStore:
         if op not in (OP_INIT, OP_SET, OP_PUSH, OP_PUSH_PULL, OP_PULL):
             return
         version = int(rname) if rname and rname.isdigit() else None
-        # build the (possibly multi-MB) snapshot copy OUTSIDE the state
-        # lock — concurrent RPC threads must not serialize behind it
         snap = None
-        if op in (OP_PULL, OP_PUSH_PULL, OP_INIT) and out is not None:
-            # INIT replies carry the store's actual value, so a
-            # first-push-wins loser records the WINNING value here, not
-            # its own rejected seed
-            snap = np.array(out)
-        elif op == OP_SET and arr is not None:
-            # force-set: our value IS the store's value now
-            snap = np.array(arr)
-        elif op == OP_INIT and arr is not None and version == 0:
-            # duck-typed store without a value in the init reply: fall
-            # back to our seed (exact only pre-push)
-            snap = np.array(arr)
+        if self._seed_enabled:
+            if op in (OP_PULL, OP_PUSH_PULL, OP_INIT) and out is not None:
+                # INIT replies carry the store's actual value, so a
+                # first-push-wins loser records the WINNING value here,
+                # not its own rejected seed.  Zero-copy: ``out`` is a
+                # view over this reply's private buffer (nothing else
+                # writes it, and user-facing returns are separate
+                # copies), so the seed is a reference, not a multi-MB
+                # copy per RPC — under contention the latest reply per
+                # name simply wins the dict slot.
+                snap = out
+            elif op == OP_SET and arr is not None:
+                # force-set: our value IS the store's value now; the
+                # caller owns (and may reuse) ``arr``, so this one copies
+                snap = np.array(arr)
+            elif op == OP_PUSH and arr is not None:
+                # status-only ack: fold the mutation into the seed
+                # ourselves.  Without this, a later failover re-seed (or
+                # failback SET) built from _last_global would silently
+                # ERASE every acked push since the last pulled value —
+                # the single-element drift the partitioned chaos smoke
+                # caught.  Exact for a single writer: the fold applies
+                # the same dense delta, cast and elementwise add the
+                # server itself performs.
+                snap = self._fold_seed(name, arr)
+            elif op == OP_INIT and arr is not None and version == 0:
+                # duck-typed store without a value in the init reply:
+                # fall back to our seed (exact only pre-push)
+                snap = np.array(arr)
         with self._state_lock:
             if version is not None:
                 self._pushed_version[(name, shard)] = version
             if snap is not None:
                 self._last_global[name] = snap
 
+    @staticmethod
+    def _dense_delta(payload):
+        """The dense array the server ADDS for this mutation payload:
+        ``decode_blob``'s reconstruction for a compressed frame (exactly
+        what the server-side frame decode produces), the raw array
+        otherwise."""
+        if isinstance(payload, WireBlob):
+            from ..compression.wire import WIRE_TAG, decode_blob
+
+            return decode_blob(WIRE_TAG, payload.data, payload.shape)
+        return payload
+
+    def _fold_seed(self, name: str, payload):
+        """``last_global[name] + dense(payload)`` — the post-mutation
+        global state, computed client-side.  Bit-exact vs the server for
+        a single writer: both sides do the same elementwise add of the
+        same dense delta in the store dtype (no reassociation).  None
+        when there is no seed yet to fold into (the name was never
+        pulled — failover re-seeding then skips it, as before)."""
+        with self._state_lock:
+            last = self._last_global.get(name)
+        if last is None:
+            return None
+        last = np.asarray(last)
+        dense = np.asarray(self._dense_delta(payload))
+        return last + dense.astype(last.dtype, copy=False)
+
     def _rpc(self, shard: int, op: int, name: str,
-             arr: Optional[np.ndarray] = None, raw: bytes = b""):
-        """Routed, retried RPC — the resilience front door."""
+             arr: Optional[np.ndarray] = None, raw: bytes = b"",
+             priority: int = 0, key: int = 0, pending=None):
+        """Routed, retried RPC — the resilience front door.
+        ``priority``/``key`` order the frame on the shard worker's send
+        queue in pipelined mode (no effect on the serial path).
+
+        ``pending`` is an optimistic already-submitted first attempt
+        (``_submit_part``): it is consumed as attempt #1 under the SAME
+        policy/deadline/version-guard machinery as a fresh send, so a
+        pipelined part that dies mid-window gets exactly the serial
+        client's retry semantics."""
         primary = shard
         policy = self._policy
         deadline = policy.start()
@@ -796,6 +925,17 @@ class RemoteStore:
                 with self._failover_lock:
                     routed = self._router.route(primary)
                     if routed != primary:
+                        if pending is not None:
+                            # the optimistic frame went to the (now
+                            # excluded) primary; abort it so a stray
+                            # mutation cannot land there while the
+                            # fallback applies ours (failback's OP_SET
+                            # overwrite heals the narrow race where it
+                            # was already applied)
+                            self._workers[primary].abort(
+                                pending,
+                                ConnectionError("re-routed to fallback"))
+                            pending = None
                         try:
                             return self._rpc_on_fallback(
                                 primary, routed, op, name, arr, raw)
@@ -810,8 +950,11 @@ class RemoteStore:
                     # BYTEPS_RETRY_DEADLINE_MS bound
                     remaining = (None if deadline == float("inf")
                                  else deadline - time.monotonic())
+                    first, pending = pending, None
                     return self._rpc_once(primary, op, name, arr, raw,
-                                          op_timeout=remaining)
+                                          op_timeout=remaining,
+                                          priority=priority, key=key,
+                                          pending=first)
                 except _WIRE_ERRORS as e:
                     err = e
                 except RuntimeError as e:
@@ -836,7 +979,8 @@ class RemoteStore:
                 policy.sleep(attempt + 1)
                 if op in (OP_PUSH, OP_PUSH_PULL):
                     # probe the shard the lost attempt actually hit
-                    resolved = self._resolve_lost_mutation(target, op, name)
+                    resolved = self._resolve_lost_mutation(target, op, name,
+                                                           arr)
                     if resolved is not None:
                         return resolved
                 continue
@@ -883,7 +1027,8 @@ class RemoteStore:
                         shard, name)
         return True
 
-    def _resolve_lost_mutation(self, shard: int, op: int, name: str):
+    def _resolve_lost_mutation(self, shard: int, op: int, name: str,
+                               arr=None):
         """After a wire failure on PUSH/PUSH_PULL, decide whether the
         lost attempt was applied (reply lost) or not (request lost): if
         the server's version advanced past the last version it
@@ -891,6 +1036,15 @@ class RemoteStore:
         double-apply.  Assumes a single writer per key (concurrent
         writers make the counter ambiguous; see docs/resilience.md).
         Returns the op's result when known-applied, else None (resend).
+
+        ``arr`` is the mutation payload: a deduplicated (applied, reply
+        lost) mutation is folded into ``_last_global`` locally — exact
+        for a single writer — so the failover seed can never lose an
+        acked mutation, and a PUSH_PULL's lost reply is reconstructed
+        without a second routed round-trip (a recovery PULL that itself
+        failed over used to adopt — and then failback-SET — a state
+        PREDATING the acked mutation: the exactly-once violation the
+        partitioned chaos smoke exposed).
         """
         if not self._version_guard:
             # multiple writers: the counter cannot attribute the advance
@@ -923,9 +1077,20 @@ class RemoteStore:
         self._counters.bump(self._cn.DEDUP, op=op, name=name, shard=shard)
         bps_log.debug("retry of %s on %r suppressed: server already at "
                       "version %d (> %d)", op, name, v, expected)
+        post = self._fold_seed(name, arr) if arr is not None else None
+        if post is not None:
+            # the applied-but-unacked value now lives in the seed: a
+            # failover re-seed (or failback SET) built from it carries
+            # this mutation instead of erasing it
+            with self._state_lock:
+                self._last_global[name] = post
         if op == OP_PUSH_PULL:
-            # mutation applied but its reply (the global tensor) was
-            # lost — a plain idempotent PULL recovers it
+            if post is not None:
+                # lost reply reconstructed locally (exact, single
+                # writer) — no second routed round-trip that could
+                # itself fail over to a shard without the mutation
+                return post, b""
+            # no seed to fold into: a plain idempotent PULL recovers it
             return self._rpc(shard, OP_PULL, name)
         return None, b""
 
@@ -1021,27 +1186,45 @@ class RemoteStore:
             self._part_meta[name] = meta
         return meta
 
+    @staticmethod
+    def _encode_raw(pname, part):
+        # identity "encode" for uncompressed legs (INIT / PULL)
+        return part, None
+
+    @staticmethod
+    def _assemble_flat(chunks, dtype) -> np.ndarray:
+        """Reassemble part arrays into ONE preallocated flat destination
+        — each part is cast + placed into its slice in a single pass
+        (the seed's ``concatenate().astype()`` made two full copies)."""
+        flat = np.empty(sum(c.size for c in chunks), dtype)
+        off = 0
+        for c in chunks:
+            flat[off:off + c.size] = c
+            off += c.size
+        return flat
+
     def init_tensor(self, name: str, value: np.ndarray) -> None:
         # INIT stays raw: it seeds the authoritative global state, which
         # must not start life quantized
-        for pname, part in self._partition(name, np.asarray(value)):
-            self._rpc(self._shard_of(pname, part.nbytes), OP_INIT, pname,
-                      part)
+        prio = self._priority_of(name)
+        parts = self._partition(name, np.asarray(value))
+        self._pipeline_parts(OP_INIT, parts, self._encode_raw, prio)
 
-    def push_delta(self, name: str, delta: np.ndarray) -> None:
+    def push_delta(self, name: str, delta: np.ndarray,
+                   priority: Optional[int] = None) -> None:
         # OP_PUSH replies status-only: no pointless global-tensor download
-        for pname, part in self._partition(name, np.asarray(delta)):
-            payload, commit = self._compressor.encode_mutation(pname, part)
-            self._rpc(self._shard_of(pname, part.nbytes), OP_PUSH, pname,
-                      payload)
-            if commit is not None:
-                commit()  # EF residual: only after the version-guarded ack
+        prio = self._priority_of(name) if priority is None else priority
+        parts = self._partition(name, np.asarray(delta))
+        self._pipeline_parts(OP_PUSH, parts,
+                             self._compressor.encode_mutation, prio)
 
     def pull(self, name: str) -> np.ndarray:
+        prio = self._priority_of(name)
         meta = self._part_names(name)
         if meta is None:
             try:
-                out, _ = self._rpc(self._shard_of(name), OP_PULL, name)
+                out, _ = self._rpc(self._shard_of(name), OP_PULL, name,
+                                   priority=prio)
                 return np.array(out)  # own the buffer
             except RuntimeError as e:
                 # possibly a tensor partitioned by another client (this
@@ -1052,27 +1235,25 @@ class RemoteStore:
                 if meta is None:
                     raise
         nparts, shape, dtype = meta
-        chunks = []
-        for i in range(nparts):
-            pname = f"{name}#p{i}"
-            out, _ = self._rpc(self._shard_of(pname), OP_PULL, pname)
-            chunks.append(np.asarray(out).reshape(-1))
-        flat = np.concatenate(chunks).astype(dtype, copy=False)
+        parts = [(f"{name}#p{i}", None) for i in range(nparts)]
+        chunks = [np.asarray(o).reshape(-1) for o in
+                  self._pipeline_parts(OP_PULL, parts, self._encode_raw,
+                                       prio)]
+        flat = self._assemble_flat(chunks, dtype)
         return flat if shape is None else flat.reshape(shape)
 
-    def push_pull(self, name: str, delta: np.ndarray) -> np.ndarray:
+    def push_pull(self, name: str, delta: np.ndarray,
+                  priority: Optional[int] = None) -> np.ndarray:
         d = np.asarray(delta)
-        outs = []
-        for pname, part in self._partition(name, d):
-            payload, commit = self._compressor.encode_mutation(pname, part)
-            out, _ = self._rpc(self._shard_of(pname, part.nbytes),
-                               OP_PUSH_PULL, pname, payload)
-            if commit is not None:
-                commit()  # EF residual: only after the version-guarded ack
-            outs.append(np.asarray(out).reshape(-1))
+        prio = self._priority_of(name) if priority is None else priority
+        parts = self._partition(name, d)
+        outs = [np.asarray(o).reshape(-1) for o in
+                self._pipeline_parts(OP_PUSH_PULL, parts,
+                                     self._compressor.encode_mutation,
+                                     prio)]
         if len(outs) == 1:
             return np.array(outs[0]).reshape(d.shape)
-        return np.concatenate(outs).reshape(d.shape)
+        return self._assemble_flat(outs, outs[0].dtype).reshape(d.shape)
 
     def version(self, name: str) -> int:
         meta = self._part_names(name)
@@ -1088,17 +1269,22 @@ class RemoteStore:
         return struct.unpack("<Q", payload)[0]
 
     def names(self) -> List[str]:
-        """Union of tensor names across shards.  Down shards are skipped
-        (their reachable names live on fallbacks and appear in those
-        listings); the union is deduplicated because a failed-over name
-        exists on both its fallback and, after recovery, its primary."""
+        """Union of tensor names across shards, queried CONCURRENTLY
+        (this sits on the recovery/``_discover_parts`` path, where a
+        serial per-shard scan added a full round-trip per shard).  Down
+        shards are skipped (their reachable names live on fallbacks and
+        appear in those listings); the union is deduplicated in shard
+        order because a failed-over name exists on both its fallback
+        and, after recovery, its primary."""
+        alive = [i for i in range(len(self._addrs))
+                 if not (self._failover_enabled and self._router.is_down(i))]
+        pend = {i: self._submit_part(i, OP_NAMES, "") for i in alive}
+        payloads = [self._rpc(i, OP_NAMES, "", pending=pend[i])[1]
+                    for i in alive]
         out: List[str] = []
         seen: set = set()
-        for i in range(len(self._addrs)):
-            if self._failover_enabled and self._router.is_down(i):
-                continue
-            _, payload = self._rpc(i, OP_NAMES, "")
-            for n in (payload.decode().split("\n") if payload else []):
+        for payload in payloads:
+            for n in (bytes(payload).decode().split("\n") if payload else []):
                 if n and n not in seen:
                     seen.add(n)
                     out.append(n)
@@ -1118,6 +1304,9 @@ class RemoteStore:
         if self._detector is not None:
             self._detector.stop()
             self._detector = None
+        if self._workers is not None:
+            for w in self._workers:
+                w.close()
         for i, s in enumerate(self._socks):
             if s is not None:
                 try:

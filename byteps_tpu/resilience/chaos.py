@@ -39,8 +39,13 @@ from typing import List, Optional, Tuple, Union
 
 from ..common import logging as bps_log
 # one wire framing, one reader: a protocol change in the PS tier must
-# break the proxy loudly at import/parse time, not silently diverge
-from ..engine.ps_server import _recv_exact, hard_reset
+# break the proxy loudly at import/parse time, not silently diverge.
+# NB the proxy relays strictly one frame at a time per connection —
+# with the pipelined client (engine/wire.py) later frames of a window
+# simply queue in the socket buffer, and a drop_* reset discards the
+# whole un-acked window at once (exactly what the client's per-request
+# retry machinery must absorb).
+from ..engine.wire import _recv_exact, hard_reset
 
 Fault = Union[str, Tuple[str, float], None]
 
@@ -165,6 +170,7 @@ class FaultInjectingProxy:
 
     def _serve_conn(self, client: socket.socket) -> None:
         upstream: Optional[socket.socket] = None
+        swallowing = False  # sticky: a hung stream answers NOTHING more
         try:
             client.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             host, port = self._target.rsplit(":", 1)
@@ -174,13 +180,19 @@ class FaultInjectingProxy:
                 except (ConnectionError, OSError):
                     return
                 fault = self._next_fault()
-                if fault in (None, "pass"):
-                    pass
-                elif fault == "blackhole":
+                if swallowing or fault == "blackhole":
                     # swallow the request; never reply — the client's
-                    # socket timeout (or heartbeat) must notice
+                    # socket timeout (or heartbeat) must notice.  Sticky
+                    # per connection: once one frame is swallowed, later
+                    # frames of the same connection must not be relayed,
+                    # or a pipelined client's FIFO reply matching would
+                    # resolve an EARLIER request with a LATER reply
+                    # (silent wrong data instead of the intended hang).
+                    swallowing = True
                     self.faults_injected += 1
                     continue
+                if fault in (None, "pass"):
+                    pass
                 elif fault == "drop_before":
                     self.faults_injected += 1
                     bps_log.debug("chaos: drop_before request #%d",

@@ -20,6 +20,10 @@ from ..common import logging as bps_log
 # ones the subsystem itself emits)
 RETRY = "resilience.retry"
 RECONNECT = "resilience.reconnect"
+# a connection reset failed a whole un-acked in-flight window of the
+# pipelined wire client (engine/wire.py) — every request in it re-enters
+# its own retry/version-guard machinery
+WINDOW_ABORT = "resilience.window_abort"
 HEARTBEAT_MISS = "resilience.heartbeat_miss"
 SHARD_DOWN = "resilience.shard_down"
 SHARD_UP = "resilience.shard_up"

@@ -18,9 +18,18 @@ mask drawn differently) would diverge the chaos run from the clean one
 on the first faulted step (docs/compression.md, "Exactly-once
 interaction").
 
+With ``--window N`` (default: the ``BYTEPS_WIRE_WINDOW`` config, i.e.
+the pipelined client) and ``--partition-bytes B`` small enough to split
+the tensors, the same bit-for-bit bar additionally proves the pipelined
+wire engine (docs/wire.md): a connection reset that kills a whole
+un-acked in-flight window of partition frames must neither drop nor
+double-apply any part, and partition EF commits must stay exactly-once
+in any completion order.
+
 Usage:
     python scripts/chaos_smoke.py [--steps 60] [--seed 0] [--rate 0.15]
-                                  [--compression randomk]
+                                  [--compression randomk] [--window 8]
+                                  [--partition-bytes 64]
 
 Wired into CI as ``slow``-marked pytests (tests/test_chaos_smoke.py —
 the compressed variant runs at a >=25% injected fault rate) so tier-1
@@ -40,12 +49,36 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 def run(steps: int = 60, seed: int = 0, rate: float = 0.15,
         dim: int = 16, verbose: bool = True,
-        compression: str = "") -> dict:
+        compression: str = "", window: int = None,
+        partition_bytes: int = None) -> dict:
+    import dataclasses
+
+    from byteps_tpu.common.config import get_config, set_config
     from byteps_tpu.compression import CompressionPolicy
     from byteps_tpu.engine import ps_server
     from byteps_tpu.resilience import (FaultInjectingProxy,
                                        ResilienceCounters, RetryPolicy)
 
+    saved_cfg = get_config()
+    if partition_bytes is not None:
+        # split every tensor into wire partitions (align small enough
+        # that tiny smoke tensors actually split).  replace(), not a
+        # fresh Config: env-derived knobs (BYTEPS_FAILOVER,
+        # BYTEPS_WIRE_WINDOW, ...) must keep applying to the run
+        set_config(dataclasses.replace(saved_cfg,
+                                       partition_bytes=partition_bytes,
+                                       partition_align=8))
+    try:
+        return _run(steps, seed, rate, dim, verbose, compression, window,
+                    ps_server, CompressionPolicy, FaultInjectingProxy,
+                    ResilienceCounters, RetryPolicy)
+    finally:
+        set_config(saved_cfg)
+
+
+def _run(steps, seed, rate, dim, verbose, compression, window,
+         ps_server, CompressionPolicy, FaultInjectingProxy,
+         ResilienceCounters, RetryPolicy) -> dict:
     names = ["w", "b", "c0", "c1"]
     target = {n: (np.arange(dim, dtype=np.float32) * (i + 1) - 3.0)
               for i, n in enumerate(names)}
@@ -75,7 +108,8 @@ def run(steps: int = 60, seed: int = 0, rate: float = 0.15,
     # ---- clean run -----------------------------------------------------
     servers = [spawn() for _ in range(2)]
     store = ps_server.RemoteStore([a for _, a in servers],
-                                  retry_policy=policy, compression=comp)
+                                  retry_policy=policy, compression=comp,
+                                  wire_window=window)
     clean = train(store)
     store.close()
     for srv, _ in servers:
@@ -93,7 +127,7 @@ def run(steps: int = 60, seed: int = 0, rate: float = 0.15,
     counters = ResilienceCounters()
     store = ps_server.RemoteStore([p.addr for p in proxies],
                                   retry_policy=policy, counters=counters,
-                                  compression=comp)
+                                  compression=comp, wire_window=window)
     chaos = train(store)
     stats = {
         "requests": sum(p.requests_seen for p in proxies),
@@ -134,9 +168,18 @@ def main() -> int:
     ap.add_argument("--compression", type=str, default="",
                     help="wire scheme for a compressed-mode run "
                          "(onebit/randomk/topk/int8/bf16/fp16)")
+    ap.add_argument("--window", type=int, default=None,
+                    help="wire window (0 = serial client; default: "
+                         "BYTEPS_WIRE_WINDOW, i.e. pipelined)")
+    ap.add_argument("--partition-bytes", type=int, default=None,
+                    help="split tensors into wire partitions of this "
+                         "size (exercises the mid-window multi-part "
+                         "fault paths)")
+    ap.add_argument("--dim", type=int, default=16)
     args = ap.parse_args()
     run(steps=args.steps, seed=args.seed, rate=args.rate,
-        compression=args.compression)
+        compression=args.compression, window=args.window,
+        partition_bytes=args.partition_bytes, dim=args.dim)
     return 0
 
 

@@ -45,3 +45,22 @@ def test_chaos_smoke_compressed_exactly_once(scheme):
                              compression=scheme)
     # seeded faults + seeded compression => identical fault/retry mix
     assert stats2["faults"] == stats1["faults"]
+
+
+@pytest.mark.slow
+def test_chaos_smoke_pipelined_partitioned_exactly_once():
+    """PR 4 acceptance (docs/wire.md): the pipelined wire client —
+    in-flight window, partitioned tensors fanned out across shards,
+    compression + error feedback on — survives the PR 3 fault rate
+    (27%) bit-for-bit.  Partitioning multiplies the mutating requests
+    per step, so this run drives window aborts, version-guard dedup AND
+    failover/failback churn (the mix that exposed the failover-seed
+    fold bug); chaos_smoke.run raises on any clean/chaos divergence."""
+    import chaos_smoke
+
+    stats = chaos_smoke.run(steps=40, seed=1, rate=0.27, verbose=False,
+                            compression="randomk", window=8,
+                            partition_bytes=24, dim=64)
+    assert stats["faults"] > 0
+    assert stats.get("resilience.window_abort", 0) > 0
+    assert stats.get("resilience.retry_dedup", 0) > 0

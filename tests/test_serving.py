@@ -208,9 +208,13 @@ def test_staggered_arrivals_and_compile_stability(tiny, prompts,
     assert eng.compile_counts() == counts
 
 
+@pytest.mark.slow
 def test_sampled_parity_seeded(tiny, prompts):
     """Seeded sampling replays generate()'s exact key chain — identical
-    draws even batched with other requests."""
+    draws even batched with other requests.  Slow-marked (PR 4 tier-1
+    budget): it compiles its own sampled decode programs for a 3-slot
+    pool; the fast 1-slot variant below keeps the key-chain replay
+    pinned in tier-1."""
     _, model, variables = tiny
     base = [np.asarray(generate(
         model, variables, p[None], M, temperature=0.8, top_k=20,
@@ -223,6 +227,22 @@ def test_sampled_parity_seeded(tiny, prompts):
     eng.drain(timeout=120)
     for r, b in zip(reqs, base):
         np.testing.assert_array_equal(r.result(), b)
+
+
+def test_sampled_parity_seeded_fast(tiny, prompts):
+    """Fast tier-1 pin of the seeded key-chain replay: one slot, one
+    request (the batched-with-other-requests case rides the slow
+    3-slot variant above)."""
+    _, model, variables = tiny
+    p = prompts[0]
+    base = np.asarray(generate(
+        model, variables, p[None], M, temperature=0.8, top_k=20,
+        rng=jax.random.PRNGKey(100))["tokens"])[0]
+    eng = ServingEngine(model, variables, n_slots=1, max_seq=64,
+                        temperature=0.8, top_k=20, metrics=ServeMetrics())
+    req = eng.submit(p, M, seed=100)
+    eng.drain(timeout=120)
+    np.testing.assert_array_equal(req.result(), base)
 
 
 def test_eos_stops_early_and_frees_slot(tiny, prompts, greedy_base,

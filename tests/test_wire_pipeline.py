@@ -1,0 +1,529 @@
+"""Pipelined wire engine (PR 4, byteps_tpu/engine/wire.py, docs/wire.md):
+windowed in-flight RPCs, shard fan-out, zero-copy framing, and the
+resilience composition — bit-identical results vs the serial client,
+exactly-once under mid-window connection resets, EF commits per part in
+any completion order, and the failover-seed fold regression the
+partitioned chaos smoke exposed.
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from byteps_tpu.common.config import Config, reset_config, set_config
+from byteps_tpu.common.context import ServerSharder, name_key
+from byteps_tpu.common.scheduler import ScheduledQueue
+from byteps_tpu.common.types import TensorTaskEntry
+from byteps_tpu.compression import CompressionPolicy
+from byteps_tpu.engine import ps_server
+from byteps_tpu.engine import wire as wire_mod
+from byteps_tpu.engine.wire import (ShardWorker, _encode, _encode_buffers,
+                                    _recv_exact, _send_buffers)
+from byteps_tpu.resilience import (FaultInjectingProxy, ResilienceCounters,
+                                   RetryPolicy, reset_counters)
+from byteps_tpu.resilience import counters as cn
+from byteps_tpu.resilience.chaos import _read_frame
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    reset_config()
+    reset_counters()
+    yield
+    reset_config()
+    reset_counters()
+
+
+def _fast_policy(**kw):
+    kw.setdefault("max_attempts", 6)
+    kw.setdefault("backoff_base", 0.01)
+    kw.setdefault("jitter", 0.0)
+    kw.setdefault("deadline", 20.0)
+    return RetryPolicy(**kw)
+
+
+def _spawn(n=1):
+    out = []
+    for _ in range(n):
+        srv, _ = ps_server.serve(0, host="127.0.0.1", use_native=False,
+                                 in_thread=True)
+        out.append((srv, f"127.0.0.1:{srv.server_address[1]}"))
+    return out
+
+
+def _stop(servers):
+    for srv, _ in servers:
+        srv.shutdown()
+        srv.server_close()
+
+
+# ------------------------------------------------------------ framing codec
+
+
+def test_encode_buffers_join_matches_legacy_frame():
+    """Scatter-gather framing is byte-identical to the seed's single
+    buffer — an old server must decode a new client verbatim."""
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    bufs = _encode_buffers(ps_server.OP_PUSH_PULL, "w", arr)
+    joined = b"".join(bytes(b) for b in bufs)
+    assert joined == _encode(ps_server.OP_PUSH_PULL, "w", arr)
+    # and the payload buffer is a zero-copy view of the array's memory
+    assert any(getattr(b, "base", None) is not None for b in bufs[1:])
+
+
+def test_encode_buffers_bf16_and_raw():
+    import ml_dtypes
+
+    arr = np.arange(8).astype(ml_dtypes.bfloat16)
+    joined = b"".join(bytes(b) for b in
+                      _encode_buffers(ps_server.OP_PUSH, "b", arr))
+    assert joined == _encode(ps_server.OP_PUSH, "b", arr)
+    raw = b"\x01\x02\x03"
+    assert (b"".join(bytes(b) for b in
+                     _encode_buffers(ps_server.OP_VERSION, "v", None, raw))
+            == _encode(ps_server.OP_VERSION, "v", None, raw))
+
+
+class _TricklingSock:
+    """sendmsg() that reports 3-byte progress per call — exercises
+    _send_buffers' partial-send handling across buffer boundaries."""
+
+    def __init__(self, real):
+        self._real = real
+
+    def sendmsg(self, buffers):
+        flat = b"".join(bytes(m) for m in buffers)[:3]
+        return self._real.sendmsg([flat])
+
+
+def test_send_buffers_partial_sends():
+    a, b = socket.socketpair()
+    try:
+        payload = [b"header", np.arange(4, dtype=np.uint8), b"tail"]
+        _send_buffers(_TricklingSock(a), payload)
+        got = _recv_exact(b, 6 + 4 + 4)
+        assert bytes(got) == b"header" + bytes(range(4)) + b"tail"
+    finally:
+        a.close()
+        b.close()
+
+
+def test_recv_exact_is_single_buffer():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(b"x" * 100)
+        got = _recv_exact(b, 100)
+        assert isinstance(got, bytearray) and len(got) == 100
+        # struct/np interop on the bytearray without a bytes() copy
+        assert struct.unpack("<B", _recv_exact(b, 0) + got[:1])[0] == 120
+    finally:
+        a.close()
+        b.close()
+
+
+def test_scheduled_queue_close_wakes_waiters():
+    q = ScheduledQueue(name="t")
+    results = []
+
+    def waiter():
+        results.append(q.wait_task(timeout=5.0))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    q.close()
+    t.join(timeout=2.0)
+    assert not t.is_alive() and results == [None]
+    assert q.wait_task(timeout=0.0) is None  # closed: immediate None
+    q.add_task(TensorTaskEntry(name="x", key=0))  # benign after close
+    assert len(q.drain()) == 1
+
+
+# -------------------------------------------------------- ShardWorker unit
+
+
+class _ManualShard:
+    """A hand-driven fake PS shard: the test reads frames and writes
+    replies explicitly, so window/priority/abort behavior is observable
+    deterministically."""
+
+    def __init__(self):
+        self.listener = socket.socket()
+        self.listener.bind(("127.0.0.1", 0))
+        self.listener.listen(4)
+        self.port = self.listener.getsockname()[1]
+        self.conn = None
+
+    def connect(self):
+        s = socket.create_connection(("127.0.0.1", self.port), timeout=5.0)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
+    def accept(self):
+        self.conn, _ = self.listener.accept()
+        self.conn.settimeout(5.0)
+        return self.conn
+
+    def read_frame_name(self):
+        frame = _read_frame(self.conn)
+        (nlen,) = struct.unpack("<I", frame[1:5])
+        return bytes(frame[5:5 + nlen]).decode()
+
+    def reply_ok(self):
+        self.conn.sendall(_encode(0, "", None))
+
+    def pending_bytes(self):
+        self.conn.setblocking(False)
+        try:
+            data = self.conn.recv(1, socket.MSG_PEEK)
+            return len(data)
+        except BlockingIOError:
+            return 0
+        finally:
+            self.conn.setblocking(True)
+            self.conn.settimeout(5.0)
+
+    def close(self):
+        for s in (self.conn, self.listener):
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+
+def test_shard_worker_window_bounds_inflight():
+    shard = _ManualShard()
+    w = ShardWorker(shard.connect, window=2, recv_timeout=5.0)
+    try:
+        pend = [w.submit(_encode_buffers(ps_server.OP_PING, f"r{i}", None),
+                         key=i) for i in range(5)]
+        shard.accept()
+        assert shard.read_frame_name() == "r0"
+        assert shard.read_frame_name() == "r1"
+        time.sleep(0.1)
+        assert shard.pending_bytes() == 0  # window=2: r2 is NOT on the wire
+        shard.reply_ok()  # ack r0 -> frees a slot
+        assert shard.read_frame_name() == "r2"
+        for _ in range(4):
+            shard.reply_ok()
+        assert shard.read_frame_name() == "r3"
+        shard.reply_ok()
+        assert shard.read_frame_name() == "r4"
+        shard.reply_ok()
+        for p in pend:
+            status, _, _, _ = w.wait(p, 5.0)
+            assert status == 0
+    finally:
+        w.close()
+        shard.close()
+
+
+def test_shard_worker_priority_order_on_wire():
+    """Frames queued while the window is full go out (priority desc,
+    key asc) — the ScheduledQueue rule — not submission order."""
+    shard = _ManualShard()
+    w = ShardWorker(shard.connect, window=1, recv_timeout=5.0)
+    try:
+        first = w.submit(_encode_buffers(ps_server.OP_PING, "first", None))
+        shard.accept()
+        assert shard.read_frame_name() == "first"
+        # window now full: these three queue up
+        low = w.submit(_encode_buffers(ps_server.OP_PING, "low", None),
+                       priority=-5, key=0)
+        hi2 = w.submit(_encode_buffers(ps_server.OP_PING, "hi2", None),
+                       priority=10, key=2)
+        hi1 = w.submit(_encode_buffers(ps_server.OP_PING, "hi1", None),
+                       priority=10, key=1)
+        time.sleep(0.1)
+        shard.reply_ok()
+        assert shard.read_frame_name() == "hi1"  # priority, then key
+        shard.reply_ok()
+        assert shard.read_frame_name() == "hi2"
+        shard.reply_ok()
+        assert shard.read_frame_name() == "low"
+        shard.reply_ok()
+        for p in (first, hi1, hi2, low):
+            assert w.wait(p, 5.0)[0] == 0
+    finally:
+        w.close()
+        shard.close()
+
+
+def test_shard_worker_timeout_aborts_connection():
+    """A wait timeout on a SENT request must kill the connection (FIFO
+    matching cannot skip a frame) and surface as socket.timeout; the
+    next submit transparently reconnects."""
+    shard = _ManualShard()
+    w = ShardWorker(shard.connect, window=2, recv_timeout=5.0)
+    try:
+        p = w.submit(_encode_buffers(ps_server.OP_PING, "hang", None))
+        shard.accept()
+        assert shard.read_frame_name() == "hang"
+        with pytest.raises(socket.timeout):
+            w.wait(p, 0.2)
+        # server side sees the connection die
+        with pytest.raises((ConnectionError, OSError)):
+            if _read_frame(shard.conn) == b"":
+                raise ConnectionError("eof")
+        # fresh submit reconnects and completes
+        p2 = w.submit(_encode_buffers(ps_server.OP_PING, "again", None))
+        shard.accept()
+        assert shard.read_frame_name() == "again"
+        shard.reply_ok()
+        assert w.wait(p2, 5.0)[0] == 0
+    finally:
+        w.close()
+        shard.close()
+
+
+def test_shard_worker_reset_fails_whole_window():
+    """A mid-window reset fails every un-acked request (each re-enters
+    its caller's retry machinery); queued-but-unsent requests survive
+    onto the next connection."""
+    shard = _ManualShard()
+    resets = []
+    w = ShardWorker(shard.connect, window=3, recv_timeout=5.0,
+                    on_reset=lambda err, n: resets.append(n))
+    try:
+        pend = [w.submit(_encode_buffers(ps_server.OP_PING, f"q{i}", None),
+                         key=i) for i in range(5)]
+        conn = shard.accept()
+        for i in range(3):
+            assert shard.read_frame_name() == f"q{i}"
+        ps_server.hard_reset(conn)  # RST with 3 un-acked in flight
+        for p in pend[:3]:
+            with pytest.raises(OSError):
+                w.wait(p, 5.0)
+        # q3/q4 were never sent: they go out on the fresh connection
+        shard.accept()
+        assert shard.read_frame_name() == "q3"
+        shard.reply_ok()
+        assert shard.read_frame_name() == "q4"
+        shard.reply_ok()
+        assert w.wait(pend[3], 5.0)[0] == 0
+        assert w.wait(pend[4], 5.0)[0] == 0
+        assert resets == [3]
+    finally:
+        w.close()
+        shard.close()
+
+
+# ----------------------------------------- RemoteStore pipelined semantics
+
+
+def test_pipelined_bit_identical_to_serial_multi_shard():
+    """Tentpole acceptance: with the window >1 and multi-part tensors
+    over 4 shards, push_pull results are bit-identical to the serial
+    client's."""
+    set_config(Config(partition_bytes=64, partition_align=8))
+    servers = _spawn(4)
+    addrs = [a for _, a in servers]
+    try:
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal(200).astype(np.float32)  # 800B -> 13 parts
+        serial = ps_server.RemoteStore(addrs, wire_window=0)
+        piped = ps_server.RemoteStore(addrs, wire_window=8)
+        serial.init_tensor("s", np.zeros_like(x))
+        piped.init_tensor("p", np.zeros_like(x))
+        for step in range(3):
+            a = serial.push_pull("s", x * (step + 1))
+            b = piped.push_pull("p", x * (step + 1))
+            assert a.tobytes() == b.tobytes()
+        assert serial.pull("s").tobytes() == piped.pull("p").tobytes()
+        assert serial.version("s") == piped.version("p") == 3
+        serial.close()
+        piped.close()
+    finally:
+        _stop(servers)
+
+
+def test_pipelined_compressed_out_of_order_part_completion():
+    """Partition EF commits stay exactly-once and bit-exact when parts
+    COMPLETE out of order (a delayed shard): two pipelined steps match
+    the serial client's two steps bit for bit, residuals included."""
+    set_config(Config(partition_bytes=32, partition_align=8))
+    # 8 parts over 2 shards: CRC linearity puts p0-p3 and p4-p7 on
+    # opposite shards for ANY name, so delaying p0's shard makes the
+    # other half complete first
+    name = "t0"
+    sh = ServerSharder(2)
+    slow_shard = sh.place(name_key(f"{name}#p0"))
+    assert sh.place(name_key(f"{name}#p4")) != slow_shard
+    x = np.linspace(-1, 1, 64, dtype=np.float32)  # 256B -> 8 parts
+
+    def run(window, delay):
+        servers = _spawn(2)
+        proxies = [FaultInjectingProxy(a, seed=0) for _, a in servers]
+        comp = CompressionPolicy(default="randomk", min_bytes=1, ratio=0.5,
+                                 seed=11)
+        st = ps_server.RemoteStore([p.addr for p in proxies],
+                                   retry_policy=_fast_policy(),
+                                   compression=comp, wire_window=window)
+        st.init_tensor(name, np.zeros_like(x))
+        if delay:
+            # parts 0-3's shard lags: parts 4-7 complete first
+            proxies[slow_shard].set_rates(delay=0.1)
+        outs = [st.push_pull(name, x), st.push_pull(name, 2 * x)]
+        res = [st._compressor.residual_norm(f"{name}#p{i}")
+               for i in range(8)]
+        st.close()
+        for p in proxies:
+            p.close()
+        _stop(servers)
+        return outs, res
+
+    (s_outs, s_res) = run(0, delay=False)
+    (p_outs, p_res) = run(8, delay=True)
+    for a, b in zip(s_outs, p_outs):
+        assert a.tobytes() == b.tobytes()
+    assert s_res == p_res
+    assert any(r > 0 for r in p_res)  # EF actually carries mass
+
+
+def test_pipelined_mid_window_reset_chaos_bit_for_bit():
+    """Satellite acceptance: a chaos run with multi-part pipelined
+    pushes where connection resets kill whole un-acked windows must
+    stay bit-for-bit identical to the clean run (nothing dropped,
+    nothing double-applied), with at least one multi-request window
+    abort actually exercised."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "scripts"))
+    import chaos_smoke
+
+    stats = chaos_smoke.run(steps=8, seed=5, rate=0.3, dim=32,
+                            verbose=False, compression="randomk",
+                            window=4, partition_bytes=32)
+    assert stats["faults"] > 0
+    assert stats.get(cn.WINDOW_ABORT, 0) > 0, (
+        "no whole-window abort fired; bump steps/rate so the run proves "
+        "the mid-window reset path")
+    assert stats.get(cn.DEDUP, 0) > 0  # drop_after dedup exercised
+
+
+def test_dedup_folds_acked_mutation_into_failover_seed():
+    """Regression for the exactly-once violation the partitioned chaos
+    smoke exposed: a mutation applied-but-unacked (drop_after -> version
+    guard dedup) must survive a failover re-seed.  Before the fix the
+    re-seed used a _last_global that PREDATED the deduplicated push, so
+    the fallback (and, after failback, the primary) lost it."""
+    shard_of_w = ServerSharder(2).place(name_key("w"))
+    servers = _spawn(2)
+    proxies = [FaultInjectingProxy(a, seed=0) for _, a in servers]
+    counters = ResilienceCounters()
+    st = ps_server.RemoteStore(
+        [p.addr for p in proxies], counters=counters,
+        retry_policy=_fast_policy(max_attempts=3, deadline=5.0))
+    try:
+        st.init_tensor("w", np.zeros(4, np.float32))
+        st.push_pull("w", np.ones(4, np.float32))          # state 1
+        proxies[shard_of_w].script("drop_after")
+        out = st.push_pull("w", 2 * np.ones(4, np.float32))  # state 3
+        np.testing.assert_allclose(out, 3.0)  # dedup reconstructed reply
+        assert counters.get(cn.DEDUP) == 1
+        # primary dies hard; ops re-route and re-seed from _last_global
+        proxies[shard_of_w].close()
+        servers[shard_of_w][0].kill()
+        np.testing.assert_allclose(st.pull("w"), 3.0)  # not 1.0
+        assert counters.get(cn.FAILOVER) >= 1
+    finally:
+        st.close()
+        for p in proxies:
+            p.close()
+        _stop(servers)
+
+
+def test_push_ack_folds_into_failover_seed():
+    """Same hole for status-only OP_PUSH acks: an acked push_delta must
+    be part of the failover seed even though its reply carries no
+    value."""
+    shard_of_w = ServerSharder(2).place(name_key("w"))
+    servers = _spawn(2)
+    st = ps_server.RemoteStore(
+        [a for _, a in servers],
+        retry_policy=_fast_policy(max_attempts=2, deadline=5.0))
+    try:
+        st.init_tensor("w", np.zeros(4, np.float32))
+        st.push_pull("w", np.ones(4, np.float32))      # seed = 1
+        st.push_delta("w", 5 * np.ones(4, np.float32))  # status-only ack
+        servers[shard_of_w][0].kill()
+        np.testing.assert_allclose(st.pull("w"), 6.0)  # fold carried it
+    finally:
+        st.close()
+        _stop(servers)
+
+
+def test_seed_cache_disabled_without_failover_flag(monkeypatch):
+    """Satellite: BYTEPS_FAILOVER=0 must skip the per-reply seed
+    snapshots entirely (they exist purely as failover/restart seeds)."""
+    monkeypatch.setenv("BYTEPS_FAILOVER", "0")
+    reset_config()
+    servers = _spawn(1)
+    st = ps_server.RemoteStore([servers[0][1]])
+    try:
+        st.init_tensor("w", np.zeros(8, np.float32))
+        st.push_pull("w", np.ones(8, np.float32))
+        st.pull("w")
+        st.push_delta("w", np.ones(8, np.float32))
+        assert st._last_global == {}
+    finally:
+        st.close()
+        _stop(servers)
+
+
+def test_pipelined_uninitialized_push_pull_raises_cleanly():
+    """A store-level error on one part must surface (not hang) and
+    leave the worker usable."""
+    set_config(Config(partition_bytes=64, partition_align=8))
+    servers = _spawn(2)
+    st = ps_server.RemoteStore(
+        [a for _, a in servers],
+        retry_policy=_fast_policy(max_attempts=1, deadline=2.0))
+    try:
+        with pytest.raises(RuntimeError, match="KeyError"):
+            st.push_pull("never_init", np.ones(100, np.float32))
+        # store still works after the failure
+        st.init_tensor("ok", np.zeros(100, np.float32))
+        np.testing.assert_allclose(
+            st.push_pull("ok", np.ones(100, np.float32)), 1.0)
+    finally:
+        st.close()
+        _stop(servers)
+
+
+def test_names_and_discovery_concurrent():
+    set_config(Config(partition_bytes=64, partition_align=8))
+    servers = _spawn(3)
+    addrs = [a for _, a in servers]
+    st = ps_server.RemoteStore(addrs)
+    try:
+        x = np.arange(100, dtype=np.float32)
+        st.init_tensor("big", x)
+        names = st.names()
+        assert sorted(names) == sorted(f"big#p{i}" for i in range(7))
+        # a fresh client discovers the parts through concurrent names()
+        st2 = ps_server.RemoteStore(addrs)
+        flat = st2.pull("big")
+        np.testing.assert_array_equal(flat, x)
+        st2.close()
+    finally:
+        st.close()
+        _stop(servers)
+
+
+def test_wire_blob_buffers_and_data_agree():
+    from byteps_tpu.compression import encode_blob, get_scheme
+
+    x = np.random.default_rng(0).standard_normal(256).astype(np.float32)
+    blob, _ = encode_blob(get_scheme("onebit"), x)
+    bufs = blob.buffers()
+    assert len(bufs) >= 2  # header + scheme data, unconcatenated
+    assert b"".join(bytes(b) for b in bufs) == blob.data
+    assert blob.nbytes == len(blob.data)
