@@ -34,6 +34,8 @@ import json
 import os
 import time
 
+from bench_util import archive_rows
+
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
@@ -418,17 +420,7 @@ def _archive_rows(rows, path="BENCH_COMM.json"):
     """Merge rows into BENCH_COMM.json by metric name (acceptance
     artifact: the pipelined-wire numbers live next to the PR-4-era
     comm matrix)."""
-    try:
-        with open(path) as f:
-            doc = json.load(f)
-    except (OSError, ValueError):
-        doc = {"rows": []}
-    new_metrics = {r["metric"] for r in rows}
-    doc["rows"] = [r for r in doc.get("rows", [])
-                   if r.get("metric") not in new_metrics] + rows
-    with open(path, "w") as f:
-        json.dump(doc, f, indent=1)
-    print(f"archived {len(rows)} rows -> {path}", flush=True)
+    archive_rows(rows, path)
 
 
 def main():

@@ -17,10 +17,20 @@ retrace in the timed window would mean steady-state serving
 recompiles, the failure mode the static slot design exists to
 prevent).
 
-Prints ONE JSON line per point (bench_comm.py convention) and writes
-the aggregate to BENCH_SERVE.json.  Runs anywhere:
+A second leg (``--prefix-share``) benchmarks the prefix-reuse KV cache
+on a shared-system-prompt workload: every request repeats one long
+prefix with a unique tail, measured prefix-cache-off vs -on (off/on
+interleaved per rep, min-of-reps — this 2-vCPU host's CPU throttling
+swings single runs).  Reported: prefix-hit rate, TTFT p50 off/on and
+the speedup, and the padded prefill tokens actually computed (the
+FLOP/token reduction the hit rate buys, robust to host throttle).
+
+Prints ONE JSON line per point and append-archives rows into
+BENCH_SERVE.json keyed by metric name (the BENCH_COMM.json pattern —
+reruns replace their own rows, never the rest).  Runs anywhere:
 
     JAX_PLATFORMS=cpu python bench_serve.py [--tokens 32] [--out ...]
+    JAX_PLATFORMS=cpu python bench_serve.py --prefix-share
 """
 
 from __future__ import annotations
@@ -28,6 +38,8 @@ from __future__ import annotations
 import argparse
 import json
 import time
+
+from bench_util import archive_rows
 
 import jax
 
@@ -40,6 +52,7 @@ from byteps_tpu.models.transformer import (  # noqa: E402
     TransformerConfig,
 )
 from byteps_tpu.serving import ServeMetrics, ServingEngine  # noqa: E402
+from byteps_tpu.serving import metrics as sm  # noqa: E402
 
 
 def _prompts(n, length, vocab):
@@ -48,9 +61,18 @@ def _prompts(n, length, vocab):
         for i in range(n)]
 
 
+def _archive_rows(rows, path="BENCH_SERVE.json"):
+    """Merge rows into BENCH_SERVE.json by metric name, dropping this
+    file's pre-archive-era whole-file keys."""
+    archive_rows(rows, path,
+                 legacy_keys=("bench", "model", "backend",
+                              "compile_counts", "points"))
+
+
 def bench(tokens: int = 64, prompt_len: int = 16, slots: int = 16,
           d_model: int = 384, layers: int = 4, vocab: int = 256,
-          concurrency=(1, 4, 8, 16), out_path: str = "BENCH_SERVE.json"):
+          concurrency=(1, 4, 8, 16), out_path: str = "BENCH_SERVE.json",
+          archive: bool = True):
     cfg = TransformerConfig(
         vocab_size=vocab, num_layers=layers, num_heads=4,
         d_model=d_model, d_ff=4 * d_model,
@@ -99,14 +121,18 @@ def bench(tokens: int = 64, prompt_len: int = 16, slots: int = 16,
         engine.drain(timeout=600)
         elapsed = time.perf_counter() - t0
         for r in reqs:
-            assert len(r.result()) == tokens
+            if len(r.result()) != tokens:
+                raise RuntimeError(f"short result: {len(r.result())}"
+                                   f" != {tokens} tokens")
         summ = engine.metrics.summary()
         counts = engine.compile_counts()
         engine.stop()
         # steady state never retraced: warmup compiled the decode
-        # program once; the timed requests reused it
-        assert counts["decode"] == 1, (
-            f"decode retraced during the timed window: {counts}")
+        # program once; the timed requests reused it (raise, not
+        # assert: the gate must survive python -O)
+        if counts["decode"] != 1:
+            raise RuntimeError(
+                f"decode retraced during the timed window: {counts}")
         tps = c * tokens / elapsed
         point = {
             "mode": "engine", "concurrency": c, "requests": c,
@@ -120,6 +146,7 @@ def bench(tokens: int = 64, prompt_len: int = 16, slots: int = 16,
             "ttft_p99_ms": round(summ["ttft_p99_s"] * 1e3, 2),
             "tpot_p50_ms": round(summ["tpot_p50_s"] * 1e3, 2),
             "queue_wait_p50_ms": round(summ["queue_wait_p50_s"] * 1e3, 2),
+            "compile_counts": dict(counts),
         }
         points.append(point)
         print(json.dumps(point))
@@ -132,24 +159,180 @@ def bench(tokens: int = 64, prompt_len: int = 16, slots: int = 16,
         "compile_counts": counts,
         "points": points,
     }
-    with open(out_path, "w") as f:
-        json.dump(result, f, indent=1)
-    print(f"wrote {out_path}")
+    if archive:
+        rows = [{"metric": ("serve_sequential" if p["mode"] == "sequential"
+                            else f"serve_engine_c{p['concurrency']}"),
+                 "backend": result["backend"], "model": result["model"],
+                 **p} for p in points]
+        _archive_rows(rows, out_path)
     return result
+
+
+def prefix_share(requests: int = 12, shared_len: int = 96,
+                 tail_len: int = 8, tokens: int = 16, slots: int = 8,
+                 d_model: int = 384, layers: int = 4, vocab: int = 256,
+                 chunk: int = 32, reps: int = 3,
+                 out_path: str = "BENCH_SERVE.json",
+                 archive: bool = True):
+    """Shared-system-prompt workload: ``requests`` prompts repeating one
+    ``shared_len`` prefix with unique ``tail_len`` tails, run through a
+    chunked engine with the prefix cache off then on (interleaved per
+    rep, min-of-reps TTFT).  The on-engine's warmup request both
+    compiles the programs and seeds the cache, so every timed admission
+    should hit.  Returns the archived row (and asserts bit-exact parity
+    between the off and on runs)."""
+    cfg = TransformerConfig(
+        vocab_size=vocab, num_layers=layers, num_heads=4,
+        d_model=d_model, d_ff=4 * d_model,
+        max_seq_len=max(128, shared_len + tail_len + tokens + 16),
+        dtype=jnp.float32)
+    model = Transformer(cfg)
+    variables = model.init(jax.random.PRNGKey(1),
+                           jnp.zeros((1, 8), jnp.int32))
+    shared = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(7), (shared_len,), 0, vocab), np.int32)
+    prompts = [np.concatenate([shared, np.asarray(jax.random.randint(
+        jax.random.PRNGKey(200 + i), (tail_len,), 0, vocab), np.int32)])
+        for i in range(requests)]
+
+    def run_mode(prefix_on: bool):
+        engine = ServingEngine(
+            model, variables, n_slots=min(slots, requests),
+            max_seq=cfg.max_seq_len, temperature=0.0,
+            max_queue=4 * requests, chunk=chunk,
+            prefix_cache=prefix_on, prefix_block=chunk,
+            metrics=ServeMetrics())
+        engine.start()
+        # warmup 1 compiles decode/chunk programs AND (on-mode) seeds
+        # the cache with the shared prefix; warmup 2 then HITS, so the
+        # jitted prefix-copy program also compiles before the timer —
+        # without it the first timed admission would pay that compile
+        engine.submit(prompts[0], tokens)
+        engine.drain(timeout=600)
+        engine.submit(prompts[0], tokens)
+        engine.drain(timeout=600)
+        engine.metrics = ServeMetrics()
+        t0 = time.perf_counter()
+        reqs = [engine.submit(p, tokens) for p in prompts]
+        engine.drain(timeout=600)
+        elapsed = time.perf_counter() - t0
+        outs = [np.asarray(r.result()) for r in reqs]
+        summ = engine.metrics.summary()
+        snap = engine.metrics.snapshot()
+        counts = engine.compile_counts()
+        engine.stop()
+        # raise, not assert: these gate the archived row and must
+        # survive python -O
+        if counts["decode"] != 1:
+            raise RuntimeError(f"decode retraced: {counts}")
+        if prefix_on and counts["prefix_copy"] != 1:
+            # the copy program must have compiled during warmup 2, not
+            # inside the timed window
+            raise RuntimeError(f"prefix_copy retraced: {counts}")
+        hits = snap.get(sm.PREFIX_HITS, 0)
+        misses = snap.get(sm.PREFIX_MISSES, 0)
+        return {
+            "elapsed_s": round(elapsed, 4),
+            "ttft_p50_ms": round(summ["ttft_p50_s"] * 1e3, 2),
+            "ttft_p99_ms": round(summ["ttft_p99_s"] * 1e3, 2),
+            "prefill_tokens": snap.get(sm.PREFILL_TOKENS, 0),
+            "prefix_hit_tokens": snap.get(sm.PREFIX_HIT_TOKENS, 0),
+            "hit_rate": (hits / (hits + misses)) if hits + misses else 0.0,
+            "compile_counts": dict(counts),
+            "outs": outs,
+        }
+
+    # off/on interleaved per rep: this host's CPU throttle drifts on
+    # the minutes scale, so alternating keeps the comparison honest;
+    # min-of-reps is the standard noise floor
+    offs, ons = [], []
+    for _ in range(max(1, reps)):
+        offs.append(run_mode(False))
+        ons.append(run_mode(True))
+    mismatches = 0
+    for off, on in zip(offs, ons):
+        for a, b in zip(off["outs"], on["outs"]):
+            if not np.array_equal(a, b):
+                mismatches += 1
+    off = min(offs, key=lambda r: r["ttft_p50_ms"])
+    on = min(ons, key=lambda r: r["ttft_p50_ms"])
+    row = {
+        "metric": "serve_prefix_share",
+        "backend": jax.default_backend(),
+        "model": {"d_model": d_model, "layers": layers, "vocab": vocab,
+                  "slots": min(slots, requests)},
+        "requests": requests, "shared_len": shared_len,
+        "tail_len": tail_len, "tokens_per_request": tokens,
+        "chunk": chunk, "reps": reps,
+        "hit_rate": round(on["hit_rate"], 4),
+        "ttft_p50_off_ms": off["ttft_p50_ms"],
+        "ttft_p50_on_ms": on["ttft_p50_ms"],
+        "ttft_speedup": round(off["ttft_p50_ms"]
+                              / max(on["ttft_p50_ms"], 1e-9), 3),
+        "elapsed_off_s": off["elapsed_s"], "elapsed_on_s": on["elapsed_s"],
+        "prefill_tokens_off": off["prefill_tokens"],
+        "prefill_tokens_on": on["prefill_tokens"],
+        "prefill_token_reduction": round(
+            1.0 - on["prefill_tokens"] / max(off["prefill_tokens"], 1),
+            4),
+        "prefix_hit_tokens": on["prefix_hit_tokens"],
+        "mismatches": mismatches,
+        "compile_counts_on": on["compile_counts"],
+    }
+    print(json.dumps(row))
+    if mismatches:
+        raise RuntimeError(
+            f"prefix cache broke token parity: {mismatches} mismatches")
+    if archive:
+        _archive_rows([row], out_path)
+    return row
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--tokens", type=int, default=64)
+    ap.add_argument("--tokens", type=int, default=None,
+                    help="new tokens per request (default 64, or 16 "
+                         "with --prefix-share)")
     ap.add_argument("--prompt-len", type=int, default=16)
-    ap.add_argument("--slots", type=int, default=16)
+    ap.add_argument("--slots", type=int, default=None,
+                    help="engine slots (default 16, or 8 with "
+                         "--prefix-share)")
     ap.add_argument("--d-model", type=int, default=384)
     ap.add_argument("--layers", type=int, default=4)
     ap.add_argument("--out", default="BENCH_SERVE.json")
+    ap.add_argument("--no-archive", action="store_true",
+                    help="do not update BENCH_SERVE.json")
+    ap.add_argument("--prefix-share", action="store_true",
+                    help="run only the shared-system-prompt prefix-"
+                         "cache A/B")
+    ap.add_argument("--shared-len", type=int, default=96)
+    ap.add_argument("--requests", type=int, default=12)
+    ap.add_argument("--chunk", type=int, default=32)
+    ap.add_argument("--reps", type=int, default=3)
     args = ap.parse_args(argv)
-    result = bench(tokens=args.tokens, prompt_len=args.prompt_len,
-                   slots=args.slots, d_model=args.d_model,
-                   layers=args.layers, out_path=args.out)
+    # the two legs have different sweet-spot defaults; explicit flags
+    # win in both
+    tokens = args.tokens if args.tokens is not None else (
+        16 if args.prefix_share else 64)
+    slots = args.slots if args.slots is not None else (
+        8 if args.prefix_share else 16)
+    if args.prefix_share:
+        row = prefix_share(requests=args.requests,
+                           shared_len=args.shared_len,
+                           tokens=tokens, slots=slots,
+                           d_model=args.d_model, layers=args.layers,
+                           chunk=args.chunk, reps=args.reps,
+                           out_path=args.out,
+                           archive=not args.no_archive)
+        ok = row["hit_rate"] >= 0.9 and row["ttft_speedup"] >= 1.3
+        print(f"prefix share: hit_rate {row['hit_rate']}, TTFT "
+              f"{row['ttft_speedup']}x "
+              f"({'PASS' if ok else 'FAIL'} >= 90% hits, >= 1.3x TTFT)")
+        return 0 if ok else 1
+    result = bench(tokens=tokens, prompt_len=args.prompt_len,
+                   slots=slots, d_model=args.d_model,
+                   layers=args.layers, out_path=args.out,
+                   archive=not args.no_archive)
     pts = {p["concurrency"]: p for p in result["points"]
            if p["mode"] == "engine"}
     sp8 = pts.get(8, {}).get("speedup_vs_sequential", 0)

@@ -143,6 +143,10 @@ class Config:
     serve_eos_id: Optional[int] = None
     serve_model: str = ""         # "k=v,..." TransformerConfig overrides
     serve_checkpoint: str = ""    # params checkpoint for the serve role
+    serve_chunk: int = 0          # chunked prefill size in tokens; 0 = off
+    serve_prefix_cache: bool = False  # prefix-reuse KV cache
+    serve_prefix_block: int = 16  # prefix match granularity (tokens)
+    serve_prefix_mb: int = 256    # prefix store byte budget (MiB); 0 = inf
 
     # --- pipelined wire engine (byteps_tpu/engine/wire.py; the client
     # half of the push/pull pipelining BytePS keeps the wire busy with —
@@ -212,6 +216,10 @@ class Config:
             serve_eos_id=_env_opt_int("BYTEPS_SERVE_EOS_ID"),
             serve_model=_env_str("BYTEPS_SERVE_MODEL", ""),
             serve_checkpoint=_env_str("BYTEPS_SERVE_CHECKPOINT", ""),
+            serve_chunk=_env_int("BYTEPS_SERVE_CHUNK", 0),
+            serve_prefix_cache=_env_bool("BYTEPS_SERVE_PREFIX_CACHE"),
+            serve_prefix_block=_env_int("BYTEPS_SERVE_PREFIX_BLOCK", 16),
+            serve_prefix_mb=_env_int("BYTEPS_SERVE_PREFIX_MB", 256),
             wire_window=_env_int("BYTEPS_WIRE_WINDOW", 8),
             wire_fanout=_env_int("BYTEPS_WIRE_FANOUT", 16),
             compression=_env_str("BYTEPS_COMPRESSION", ""),

@@ -142,6 +142,41 @@ class ScheduledQueue:
                 self._credits += task.length
             self._cv.notify_all()
 
+    def try_debit(self, n: int) -> bool:
+        """Consume ``n`` credits for work granted *outside* the queue —
+        the serving engine's prefill continuation chunks share one
+        credit pool with its queued admissions (serving/scheduler.py).
+        Returns False (and debits nothing) when the remaining credits
+        cannot cover ``n``; always True on an unscheduled queue.  Pair
+        every successful debit with :meth:`credit`."""
+        with self._cv:
+            if not self._is_scheduled:
+                return True
+            if n > self._credits:
+                return False
+            self._credits -= n
+            return True
+
+    def credit(self, n: int) -> None:
+        """Return ``n`` directly-debited credits (see :meth:`try_debit`)."""
+        with self._cv:
+            if self._is_scheduled:
+                self._credits += n
+                self._cv.notify_all()
+
+    def remove(self, task: TensorTaskEntry) -> bool:
+        """Remove a still-pending task without granting it (eager
+        cancellation).  No credit accounting: the task was never
+        debited.  False when the task is no longer queued (already
+        granted or drained) — the caller falls back to grant-time
+        retirement."""
+        with self._cv:
+            for i, queued in enumerate(self._queue):
+                if queued is task:
+                    del self._queue[i]
+                    return True
+            return False
+
     def pending(self) -> int:
         with self._lock:
             return len(self._queue)

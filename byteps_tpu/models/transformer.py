@@ -787,7 +787,7 @@ class Transformer(nn.Module):
     def __call__(self, tokens):
         return self.logits(self.hidden(tokens))
 
-    def decode(self, tokens, caches, pos, last_only=False):
+    def decode(self, tokens, caches, pos, last_only=False, last_idx=None):
         """One autoregressive step over ``tokens [B, tq]`` at absolute
         offset ``pos`` (traced scalar) against per-layer KV caches.
 
@@ -801,6 +801,9 @@ class Transformer(nn.Module):
         (logits ``[B, 1, vocab]``) — generation prefill needs just the
         next-token distribution, and the full ``[B, tq, vocab]`` fp32
         logits would otherwise dominate prefill HBM at real vocab sizes.
+        ``last_idx`` (a traced scalar) is the same head narrowing at a
+        *dynamic* position — a right-padded chunk's true last prompt
+        token instead of the literal last row (see ``prefill_chunk``).
         """
         x = self.embed(tokens)
         if self.cfg.pos_emb == "learned":
@@ -811,7 +814,39 @@ class Transformer(nn.Module):
             new_caches.append(nc)
         if last_only:
             x = x[:, -1:]
+        elif last_idx is not None:
+            x = jax.lax.dynamic_slice_in_dim(x, last_idx, 1, axis=1)
         return self.logits(self.ln_f(x)), tuple(new_caches)
+
+    def prefill_chunk(self, tokens, caches, pos, last_idx):
+        """Position-offset prefill: one chunk ``tokens [B, C]`` written
+        into the caches at absolute positions ``[pos, pos + C)`` (``pos``
+        a traced scalar, unlike the static ``pos=0`` whole-prompt
+        prefill), returning the logits at chunk-local index ``last_idx``
+        only (``[B, 1, vocab]``).
+
+        This is the serving engine's chunked-prefill step
+        (serving/engine.py): a long prompt runs as a sequence of these
+        calls interleaved with decode ticks instead of one monolithic
+        prefill, and a prefix-cache hit resumes prefill at the copied
+        boundary.  Chunking is bit-exact against whole-prompt prefill:
+        hidden states (and therefore K/V) at each position depend only
+        on positions at or before it, every per-position computation is
+        row-independent, and attention always runs against the
+        full-length cache buffer with the same causal mask — masked
+        slots contribute exactly-zero probability (docs/serving.md).
+        ``last_idx`` exists for the final chunk of a right-padded
+        prompt: the LM head reads the true last prompt token, never the
+        padding (mid-chunk callers discard the logits).
+
+        Requires a **dense** cache: at a traced ``pos`` attention reads
+        the stored K/V, which under a quantized cache is already int8,
+        while the static ``pos=0`` whole-prompt path attends the exact
+        pre-quantization values — chunking a quantized cache would
+        silently change first-token logits (``ServingEngine`` refuses
+        the combination).
+        """
+        return self.decode(tokens, caches, pos, last_idx=last_idx)
 
 
 def init_cache(cfg: TransformerConfig, batch_size: int, max_len: int,

@@ -14,9 +14,14 @@ kernels into a *serving engine*:
     credit budget, FIFO within priority, with a bounded queue that
     rejects loudly when full;
   * ``engine`` — the jitted step functions (batched single-token decode
-    over the whole slot pool; bucket-padded prefill) plus the host-side
-    tick loop; static shapes end to end, so steady-state serving never
-    retraces;
+    over the whole slot pool; bucket-padded prefill, optionally split
+    into position-offset chunks so long prompts interleave with decode
+    ticks instead of stalling them) plus the host-side tick loop;
+    static shapes end to end, so steady-state serving never retraces;
+  * ``prefix`` — a refcounted, LRU/byte-budgeted store of block-aligned
+    KV prefixes keyed by a rolling token hash: shared system prompts
+    are copied device-side into the slot row instead of recomputed
+    (bit-exact — the bytes move, nothing is re-derived);
   * ``frontend`` — an in-process ``ServeClient`` (submit / stream /
     cancel / drain) and a thin length-prefixed TCP frontend launched by
     ``launcher.py`` under the ``serve`` role;
@@ -31,6 +36,11 @@ see docs/serving.md.
 from .engine import Request, RequestState, ServingEngine  # noqa: F401
 from .frontend import ServeClient, serve, serve_from_env  # noqa: F401
 from .metrics import ServeMetrics, get_serve_metrics  # noqa: F401
+from .prefix import (  # noqa: F401
+    PrefixCache,
+    PrefixEntry,
+    weights_fingerprint,
+)
 from .scheduler import (  # noqa: F401
     AdmissionError,
     PrefillTask,

@@ -16,6 +16,22 @@ requests flow through:
     takes), gather the true last position's logits, ``dynamic_update_
     slice`` the row back.  Prompts are right-padded to power-of-two
     buckets so the compile count is O(log max_seq), not O(#lengths).
+  * **chunked prefill** (``chunk > 0``) — the prefill generalized to
+    position-offset chunks (``Transformer.prefill_chunk``): a long
+    prompt runs as a sequence of ``[p0, p0 + C)`` chunk calls spread
+    over consecutive ticks, each debiting the SAME credit pool the
+    admission grants use, so no tick's prefill work exceeds the budget
+    and decoding requests keep emitting between chunks (SARATHI-style
+    stall bounding).  Requests sit in the ``PREFILLING`` state (slot
+    assigned, excluded from decode) until their final chunk samples
+    the first token.  Chunk buckets are powers of two capped at the
+    chunk size — O(log chunk) compiled programs.
+  * **prefix reuse** (``prefix_cache``) — before the first chunk, the
+    longest block-aligned cached prefix of the prompt (serving/
+    prefix.py) is copied device-side into the slot row by a jitted
+    copy program (one trace — entries are full-row buffers), and
+    prefill resumes at the boundary.  Bit-exact by construction: the
+    K/V bytes are copied, not recomputed.
 
 **Determinism / parity contract** (the correctness anchor, pinned by
 tests/test_serving.py and scripts/serve_smoke.py): per request, the
@@ -39,6 +55,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import hashlib
 import queue
 import threading
 import time
@@ -53,6 +70,7 @@ from ..inference import sample_logits
 from ..models.transformer import Transformer
 from . import metrics as sm
 from .metrics import ServeMetrics, get_serve_metrics
+from .prefix import PrefixCache, weights_fingerprint
 from .scheduler import ServeScheduler
 from .slots import SlotPool
 
@@ -61,6 +79,7 @@ __all__ = ["Request", "RequestState", "ServingEngine"]
 
 class RequestState(enum.Enum):
     QUEUED = "queued"
+    PREFILLING = "prefilling"  # slot assigned, chunked prefill in flight
     ACTIVE = "active"
     DONE = "done"
     CANCELLED = "cancelled"
@@ -85,6 +104,14 @@ class Request:
     cancelled: bool = False
     tokens: List[int] = dataclasses.field(default_factory=list)
     slot: Optional[int] = None
+    prefill_pos: int = 0  # prompt tokens already in the slot's K/V rows
+    _pf_paid: bool = dataclasses.field(default=False, repr=False)
+    # rolling prefix-block digests, computed once at admit and reused
+    # for the post-prefill insert (one blake2b per block per pass —
+    # recomputing them three times per request sits on the tick thread)
+    _prefix_digs: Optional[List[bytes]] = dataclasses.field(
+        default=None, repr=False)
+    _task: Optional[object] = dataclasses.field(default=None, repr=False)
     t_submit: float = 0.0
     t_admit: float = 0.0
     t_first: float = 0.0
@@ -181,6 +208,10 @@ class ServingEngine:
                  max_queue: int = 64,
                  prefill_credits: Optional[int] = None,
                  min_prefill_bucket: int = 8,
+                 chunk: int = 0,
+                 prefix_cache=False,
+                 prefix_block: int = 16,
+                 prefix_bytes: int = 256 << 20,
                  metrics: Optional[ServeMetrics] = None):
         self.model = model
         self.variables = variables
@@ -193,14 +224,95 @@ class ServingEngine:
         self.pad_id = pad_id
         self.greedy = temperature == 0
         self.min_prefill_bucket = max(1, min_prefill_bucket)
+        # chunked prefill: normalize the chunk size onto the prefill
+        # bucket grid (power-of-two multiple of min_prefill_bucket) so
+        # every mid chunk hits one compiled program; 0 = whole-prompt
+        # prefill (the PR 2 path, bit-identical)
+        self.chunk = (_next_bucket(chunk, self.min_prefill_bucket,
+                                   self.max_seq) if chunk and chunk > 0
+                      else 0)
+        # prefix-reuse KV cache: True builds a private store, or pass a
+        # PrefixCache to share one across engines with IDENTICAL pool
+        # geometry (entries are full cache-row buffers).  Every key is
+        # salted with a fingerprint of THIS engine's weights, so
+        # engines serving different checkpoints through a shared store
+        # occupy disjoint key spaces — one model's K/V can never be
+        # copied into another model's slot
+        if isinstance(prefix_cache, PrefixCache):
+            self.prefix = prefix_cache
+        elif prefix_cache:
+            self.prefix = PrefixCache(block=prefix_block,
+                                      max_bytes=prefix_bytes)
+        else:
+            self.prefix = None
+        # chunk (and prefix-resumed) prefill attends at a TRACED
+        # position, which under kv_quant reads the already-quantized
+        # int8 K/V — whole-prompt prefill at static pos=0 reads the
+        # pre-quantization values instead (models/transformer.py dense
+        # fallback), so the combination would silently diverge from
+        # generate() and from a chunk=0 engine.  Refuse loudly.
+        if kv_quant and (self.chunk or self.prefix is not None):
+            raise ValueError(
+                "chunked prefill / prefix cache require a dense KV "
+                "cache: a chunk at a traced position attends int8 K/V "
+                "where whole-prompt prefill attends the "
+                "pre-quantization values, breaking the bit-exact "
+                "parity contract.  Run kv_quant engines with chunk=0 "
+                "and prefix_cache=False.")
+        # same hazard class for flash prefill: whole-prompt prefill at
+        # static pos=0 can take the Pallas flash kernel (attn_impl=
+        # "flash" + the gcd bucket gate), while a chunk at a traced
+        # position always takes dense cached attention — the two differ
+        # in accumulation order, so greedy tokens could silently
+        # diverge from generate().  max_seq < 128 can never produce a
+        # flash-eligible bucket (the gate needs gcd(bucket, 1024) >=
+        # 128 and buckets never exceed max_seq), so tiny configs pass.
+        if (self.chunk or self.prefix is not None) and (
+                cfg.attn_impl == "flash" and not cfg.has_sp
+                and self.max_seq >= 128):
+            raise ValueError(
+                "chunked prefill / prefix cache require the dense "
+                "prefill path: this config's whole-prompt prefill can "
+                "take the flash kernel while chunks always take dense "
+                "cached attention, and the two differ in accumulation "
+                "order — token streams could silently diverge from "
+                "generate().  Serve attn_impl='flash' models with "
+                "chunk=0 and prefix_cache=False.")
         self.pool = SlotPool(cfg, n_slots, self.max_seq,
                              kv_quant=kv_quant, layout=cache_layout)
+        # every prefix entry is one full cache row, so its size is fixed
+        # by the pool geometry; when even one can never fit the byte
+        # budget, _maybe_insert_prefix skips the device-side extract
+        # entirely instead of paying it per request just for insert()
+        # to refuse
+        self._prefix_row_bytes = (sum(
+            leaf.nbytes // n_slots
+            for leaf in jax.tree_util.tree_leaves(self.pool.caches))
+            if self.prefix is not None else 0)
+        # the store salt commits to the weights AND the per-slot cache
+        # row geometry (shape past the slot dim, dtype): an engine with
+        # a different max_seq / layout / kv_quant sharing the store
+        # sees a harmless miss instead of copying an incompatible
+        # buffer and crashing the tick
+        self._prefix_salt = b""
+        if self.prefix is not None:
+            geom = hashlib.blake2b(digest_size=16)
+            for leaf in jax.tree_util.tree_leaves(self.pool.caches):
+                geom.update(f"{leaf.shape[1:]}{leaf.dtype}".encode())
+            self._prefix_salt = (weights_fingerprint(variables)
+                                 + geom.digest())
         # credit budget in padded prefill tokens per tick; default = one
-        # max-length prefill, i.e. "a tick admits at most one worst-case
-        # prompt's worth of prefill work" — decode latency stays bounded
-        # while short prompts can still batch several admissions per tick
+        # max-length prefill (or, with chunking on, one chunk — the
+        # whole point is bounding per-tick prefill), i.e. "a tick admits
+        # at most one worst-case prompt's worth of prefill work" —
+        # decode latency stays bounded while short prompts can still
+        # batch several admissions per tick.  With chunking the budget
+        # is floored at the chunk size so a continuation chunk can
+        # always make progress on a fresh tick.
         budget = (prefill_credits if prefill_credits and prefill_credits > 0
-                  else self.max_seq)
+                  else (self.chunk or self.max_seq))
+        if self.chunk:
+            budget = max(budget, self.chunk)
         self.scheduler = ServeScheduler(
             max_queue=max_queue, credit_budget=budget)
         self.metrics = metrics if metrics is not None else get_serve_metrics()
@@ -208,6 +320,12 @@ class ServingEngine:
         self._lock = threading.RLock()
         self._req_seq = 0
         self._slot_req: List[Optional[Request]] = [None] * n_slots
+        # slots mid-chunked-prefill: assigned (cache rows being written)
+        # but excluded from the decode pass until the final chunk
+        # samples their first token
+        self._prefilling: Dict[int, Request] = {}
+        self._tick_chunk_debt = 0   # take_credits() debits to return
+        self._tick_prefill = 0      # padded prefill tokens this tick
         self._tok = jnp.zeros((n_slots,), jnp.int32)
         self._keys = jnp.zeros((n_slots, 2), jnp.uint32)
         self._outstanding = 0
@@ -221,34 +339,66 @@ class ServingEngine:
         # steady-state stability is asserted on them
         self.decode_traces = 0
         self.prefill_traces = 0
+        self.chunk_traces = 0
+        self.prefix_copy_traces = 0
+        self.prefix_extract_traces = 0
         # donate the cache pool into each step: the pool is replaced by
         # the step's output, and without donation XLA would copy every
         # layer's full [N, S, ...] cache per tick just to write one row
         self._decode_step = jax.jit(self._make_decode_fn(),
                                     donate_argnums=(1,))
         self._prefill_fns: Dict[int, object] = {}
+        self._chunk_fns: Dict[int, object] = {}
+        self._copy_fn = None
+        self._extract_fn = None
 
     # ---------------------------------------------------- jitted programs
+    #
+    # The decode, prefill, and chunk programs all end with the same
+    # "pick a token, write the slot's row back" tail; it lives in ONE
+    # place (_select_token/_slot_row/_write_row) so a fix to the
+    # sampling key chain or the write-back discipline cannot silently
+    # diverge between paths — the bit-exact parity anchor depends on
+    # every path agreeing.
+
+    def _select_token(self, logits_last, key):
+        """Greedy/sampled token pick from ``[1, vocab]`` last-position
+        logits, returning ``(token, carried_key)``.  Sampled mode
+        replays generate()'s exact per-step key chain: carry split[0],
+        sample with split[1]; greedy carries the key untouched."""
+        if self.greedy:
+            return jnp.argmax(logits_last[0], axis=-1).astype(jnp.int32), key
+        nk, sub = jax.random.split(key)
+        tok = sample_logits(logits_last, sub, self.temperature,
+                            self.top_k, self.top_p)[0].astype(jnp.int32)
+        return tok, nk
+
+    @staticmethod
+    def _slot_row(caches, slot):
+        """Slice one slot's ``[1, ...]`` cache row out of the pool."""
+        return jax.tree_util.tree_map(
+            lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=0),
+            caches)
+
+    @staticmethod
+    def _write_row(caches, new_row, slot):
+        """Write a ``[1, ...]`` row back into the (donated) pool."""
+        return jax.tree_util.tree_map(
+            lambda c, r: jax.lax.dynamic_update_slice_in_dim(
+                c, r, slot, axis=0),
+            caches, new_row)
 
     def _make_decode_fn(self):
         model, greedy = self.model, self.greedy
-        temperature, top_k, top_p = self.temperature, self.top_k, self.top_p
         pad_id = self.pad_id
+        select = self._select_token
 
         def one(variables, row, tok, pos, key):
             rowb = jax.tree_util.tree_map(lambda c: c[None], row)
             logits, new = model.apply(
                 variables, tok[None, None], rowb, pos,
                 method=Transformer.decode)
-            if greedy:
-                nxt = jnp.argmax(logits[0, -1], axis=-1).astype(jnp.int32)
-                nk = key
-            else:
-                # generate()'s exact per-step key chain: carry split[0],
-                # sample with split[1]
-                nk, sub = jax.random.split(key)
-                nxt = sample_logits(logits[:, -1], sub, temperature,
-                                    top_k, top_p)[0].astype(jnp.int32)
+            nxt, nk = select(logits[:, -1], key)
             return jax.tree_util.tree_map(lambda c: c[0], new), nxt, nk
 
         def decode_fn(variables, caches, tok, pos, active, keys):
@@ -269,32 +419,78 @@ class ServingEngine:
         fn = self._prefill_fns.get(bucket)
         if fn is not None:
             return fn
-        model, greedy = self.model, self.greedy
-        temperature, top_k, top_p = self.temperature, self.top_k, self.top_p
+        model, select = self.model, self._select_token
 
         def prefill_fn(variables, caches, prompt, slot, true_len, key):
             self.prefill_traces += 1  # trace-time only
-            row = jax.tree_util.tree_map(
-                lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=0),
-                caches)
             logits, new_row = model.apply(
-                variables, prompt, row, true_len, method=_prefill_forward)
-            if greedy:
-                tok0 = jnp.argmax(logits[0, -1], axis=-1).astype(jnp.int32)
-                nk = key
-            else:
-                nk, sub = jax.random.split(key)
-                tok0 = sample_logits(logits[:, -1], sub, temperature,
-                                     top_k, top_p)[0].astype(jnp.int32)
-            caches = jax.tree_util.tree_map(
-                lambda c, r: jax.lax.dynamic_update_slice_in_dim(
-                    c, r, slot, axis=0),
-                caches, new_row)
-            return caches, tok0, nk
+                variables, prompt, self._slot_row(caches, slot), true_len,
+                method=_prefill_forward)
+            tok0, nk = select(logits[:, -1], key)
+            return self._write_row(caches, new_row, slot), tok0, nk
 
         fn = jax.jit(prefill_fn, donate_argnums=(1,))
         self._prefill_fns[bucket] = fn
         return fn
+
+    def _chunk_fn(self, bucket: int):
+        """Jitted position-offset chunk prefill for one bucket size:
+        writes the chunk's K/V at ``[start, start + bucket)`` of the
+        slot's row and returns the sampled token at chunk-local
+        ``last_idx`` (meaningful only for a request's final chunk —
+        mid-chunk callers discard it, and the carried key, so the
+        sampling key chain still splits exactly once per request)."""
+        fn = self._chunk_fns.get(bucket)
+        if fn is not None:
+            return fn
+        model, select = self.model, self._select_token
+
+        def chunk_fn(variables, caches, tokens, slot, start, last_idx, key):
+            self.chunk_traces += 1  # trace-time only
+            logits, new_row = model.apply(
+                variables, tokens, self._slot_row(caches, slot), start,
+                last_idx, method=Transformer.prefill_chunk)
+            tok0, nk = select(logits[:, -1], key)
+            return self._write_row(caches, new_row, slot), tok0, nk
+
+        fn = jax.jit(chunk_fn, donate_argnums=(1,))
+        self._chunk_fns[bucket] = fn
+        return fn
+
+    def _prefix_copy_fn(self):
+        """Jitted device-side prefix restore: overwrite a slot's whole
+        cache row with a stored full-row buffer.  Rows past the match
+        length are the buffer's zero padding — safe stale content, the
+        request's own prefill/decode overwrites them before the causal
+        mask can admit them.  Full-row entries keep this ONE compiled
+        program regardless of prefix length."""
+        if self._copy_fn is None:
+            def copy_fn(caches, buffer, slot):
+                self.prefix_copy_traces += 1  # trace-time only
+                return self._write_row(caches, buffer, slot)
+
+            self._copy_fn = jax.jit(copy_fn, donate_argnums=(0,))
+        return self._copy_fn
+
+    def _prefix_extract_fn(self):
+        """Jitted prefix capture: copy a slot's cache row with positions
+        ``>= length`` zero-masked (one compiled program for every
+        length).  NOT donated — the pool keeps its buffers."""
+        if self._extract_fn is None:
+            def extract_fn(caches, slot, length):
+                self.prefix_extract_traces += 1  # trace-time only
+
+                def ext(c):
+                    row = jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=0)
+                    idx = jnp.arange(row.shape[1]).reshape(
+                        (1, -1) + (1,) * (row.ndim - 2))
+                    return jnp.where(idx < length, row,
+                                     jnp.zeros_like(row))
+
+                return jax.tree_util.tree_map(ext, caches)
+
+            self._extract_fn = jax.jit(extract_fn)
+        return self._extract_fn
 
     # ------------------------------------------------------------- submit
 
@@ -315,6 +511,10 @@ class ServingEngine:
                 f"prompt ({T}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds engine max_seq {self.max_seq}")
         bucket = _next_bucket(T, self.min_prefill_bucket, self.max_seq)
+        if self.chunk:
+            # the admission grant pays for the FIRST chunk only; each
+            # continuation chunk debits the same pool at process time
+            bucket = min(bucket, self.chunk)
         # dead-engine check AND enqueue under the engine lock, which
         # _fail_all holds while draining: a submit racing the failure
         # path must either land before the drain (and be failed by it)
@@ -335,7 +535,7 @@ class ServingEngine:
                           priority=priority, t_submit=time.monotonic())
             self._outstanding += 1
             try:
-                self.scheduler.submit(req, bucket)
+                req._task = self.scheduler.submit(req, bucket)
             except Exception:
                 self._outstanding -= 1
                 self._drain_cv.notify_all()  # same lock; wake waiters
@@ -347,9 +547,17 @@ class ServingEngine:
         return req
 
     def cancel(self, req: Request) -> None:
-        """Request cancellation; the engine retires the request on its
-        next tick (queued requests are dropped at grant time)."""
+        """Request cancellation.  A still-QUEUED request is dropped from
+        the admission queue immediately (it stops holding queue depth
+        and never consumes a grant); in-flight requests are retired on
+        the engine's next tick.  The eager drop races admission under
+        the engine lock: whichever side pops the task first wins, and
+        the grant-time cancelled check stays as the fallback."""
         req.cancelled = True
+        with self._lock:
+            if (req.state is RequestState.QUEUED and req._task is not None
+                    and self.scheduler.remove(req._task)):
+                self._finish(req, RequestState.CANCELLED)
         with self._wake:
             self._wake.notify_all()
 
@@ -364,14 +572,24 @@ class ServingEngine:
     def _step_locked(self) -> Dict[str, int]:
         emitted = 0
         granted: List = []
+        self._tick_chunk_debt = 0
+        self._tick_prefill = 0
         try:
-            # 0. retire cancelled active requests (frees their slots
-            # for this tick's admissions)
+            # 0. retire cancelled active/prefilling requests (frees
+            # their slots for this tick's admissions)
             for slot in self.pool.active_slots():
                 req = self._slot_req[slot]
                 if req is not None and req.cancelled:
                     self._finish(req, RequestState.CANCELLED)
-            # 1. admissions, in scheduler grant order (priority desc,
+            # 1. continue in-flight chunked prefills (slot order) —
+            # BEFORE new admissions: finishing started work frees
+            # capacity soonest, and the continuation debits shrink the
+            # credit pool the admission scan below sees
+            for slot in sorted(self._prefilling):
+                req = self._prefilling.get(slot)
+                if req is not None:
+                    emitted += self._advance_prefill(req)
+            # 2. admissions, in scheduler grant order (priority desc,
             # FIFO)
             free = self.pool.free_count
             if free:
@@ -381,8 +599,11 @@ class ServingEngine:
                         self._finish(task.request, RequestState.CANCELLED)
                     else:
                         emitted += self._admit(task.request)
-            # 2. one decode pass over the pool
-            active = self.pool.active_slots()
+            # 3. one decode pass over the pool (PREFILLING slots are
+            # assigned but not yet decodable — their first token comes
+            # from their final prefill chunk)
+            active = [s for s in self.pool.active_slots()
+                      if s not in self._prefilling]
             if active:
                 emitted += self._decode_tick(active)
         except Exception as e:
@@ -398,12 +619,16 @@ class ServingEngine:
                     self._finish(req, RequestState.FAILED)
             raise
         finally:
-            # 3. credits back — in normal ticks AFTER decode, so the
+            # 4. credits back — in normal ticks AFTER decode, so the
             # budget truly bounds the prefill work interleaved between
             # consecutive decode passes; on a failed tick, so the
-            # credits of granted work are never leaked
+            # credits of granted work (and continuation-chunk debits)
+            # are never leaked
             for task in granted:
                 self.scheduler.finish(task)
+            if self._tick_chunk_debt:
+                self.scheduler.return_credits(self._tick_chunk_debt)
+                self._tick_chunk_debt = 0
         # idle ticks (background poll with nothing in flight) emit no
         # gauges — a traced long-lived server would otherwise append
         # two counter events per 50ms poll to the Tracer's in-memory
@@ -414,32 +639,154 @@ class ServingEngine:
                                       self.scheduler.depth, emitted)
         return {"admitted": len(granted), "emitted": emitted,
                 "active": self.pool.active_count,
-                "queued": self.scheduler.depth}
+                "queued": self.scheduler.depth,
+                "prefill_tokens": self._tick_prefill}
 
     def _admit(self, req: Request) -> int:
         T = int(req.prompt.shape[0])
         slot = self.pool.assign(req.id, T)
         assert slot is not None, "admit() granted beyond free slots"
         req.slot = slot
-        req.state = RequestState.ACTIVE
         req.t_admit = time.monotonic()
         self._slot_req[slot] = req
-        bucket = _next_bucket(T, self.min_prefill_bucket, self.max_seq)
-        padded = np.full((1, bucket), self.pad_id, np.int32)
-        padded[0, :T] = req.prompt
-        key = (jnp.zeros((2,), jnp.uint32) if self.greedy
-               else jax.random.PRNGKey(req.seed))
-        fn = self._prefill_fn(bucket)
-        caches, tok0, nk = fn(self.variables, self.pool.caches,
-                              jnp.asarray(padded), slot, T, key)
-        self.pool.caches = caches
-        self._tok = self._tok.at[slot].set(tok0)
-        if not self.greedy:
-            self._keys = self._keys.at[slot].set(nk)
         self.metrics.bump(sm.ADMITTED)
-        self.metrics.bump(sm.PREFILL_TOKENS, bucket)
-        self._emit(req, int(tok0))
-        return 1
+        p0 = 0
+        if self.prefix is not None:
+            req._prefix_digs = self.prefix.digests_for(
+                req.prompt, salt=self._prefix_salt)
+            m = self.prefix.match(req.prompt, salt=self._prefix_salt,
+                                  digests=req._prefix_digs)
+            if m is not None:
+                entry, p0 = m
+                # pin across the device copy, then resume prefill at
+                # the boundary — the copied bytes ARE the K/V whole
+                # prefill would recompute, so parity is by construction
+                self.prefix.acquire(entry)
+                try:
+                    self.pool.caches = self._prefix_copy_fn()(
+                        self.pool.caches, entry.buffer, slot)
+                finally:
+                    self.prefix.release(entry)
+                self.metrics.bump(sm.PREFIX_HITS)
+                self.metrics.bump(sm.PREFIX_HIT_TOKENS, p0)
+            else:
+                self.metrics.bump(sm.PREFIX_MISSES)
+        if p0 == 0 and not self.chunk:
+            # whole-prompt prefill (the pre-chunking path, bit-identical)
+            req.state = RequestState.ACTIVE
+            bucket = _next_bucket(T, self.min_prefill_bucket, self.max_seq)
+            padded = np.full((1, bucket), self.pad_id, np.int32)
+            padded[0, :T] = req.prompt
+            key = (jnp.zeros((2,), jnp.uint32) if self.greedy
+                   else jax.random.PRNGKey(req.seed))
+            fn = self._prefill_fn(bucket)
+            caches, tok0, nk = fn(self.variables, self.pool.caches,
+                                  jnp.asarray(padded), slot, T, key)
+            self.pool.caches = caches
+            self._tok = self._tok.at[slot].set(tok0)
+            if not self.greedy:
+                self._keys = self._keys.at[slot].set(nk)
+            self.metrics.bump(sm.PREFILL_TOKENS, bucket)
+            self._tick_prefill += bucket
+            self._maybe_insert_prefix(req)
+            self._emit(req, int(tok0))
+            return 1
+        # chunked (or prefix-resumed) prefill: the request parks in
+        # PREFILLING with the slot held; the admission grant pre-paid
+        # its first chunk, later chunks debit the shared credit pool
+        req.state = RequestState.PREFILLING
+        req.prefill_pos = p0
+        req._pf_paid = True
+        self._prefilling[slot] = req
+        return self._advance_prefill(req)
+
+    def _advance_prefill(self, req: Request) -> int:
+        """Run as many prefill chunks for ``req`` as the tick's credits
+        allow.  Returns 1 when the final chunk completed (first token
+        emitted), else 0 — the request stays PREFILLING and resumes on
+        the next tick's continuation pass with a fresh budget."""
+        T = int(req.prompt.shape[0])
+        slot = req.slot
+        S = self.max_seq
+        while True:
+            p0 = req.prefill_pos
+            csize = (min(T - p0, self.chunk) if self.chunk else T - p0)
+            bucket = _next_bucket(csize, self.min_prefill_bucket,
+                                  self.chunk or self.max_seq)
+            if p0 and p0 + bucket > S and p0 + self.min_prefill_bucket <= S:
+                # a covering bucket would overrun the row, and the
+                # boundary guard below would then shift the chunk left
+                # across positions the prefix copy (or earlier chunks)
+                # already wrote — recomputing exactly what the reuse
+                # saved.  Split instead: take the largest bucket that
+                # fits at p0 and leave the tail to the next loop pass
+                fit = self.min_prefill_bucket
+                while fit * 2 <= S - p0:
+                    fit *= 2
+                bucket = fit
+                csize = min(csize, bucket)
+            # clamp the debit to the whole budget, exactly like
+            # ServeScheduler.submit clamps an admission grant — a
+            # bucket larger than the budget could otherwise NEVER be
+            # paid for and the request would sit in PREFILLING forever
+            need = (min(bucket, self.scheduler.credit_budget)
+                    if self.scheduler.credit_budget > 0 else bucket)
+            if req._pf_paid:
+                req._pf_paid = False
+            elif self.scheduler.take_credits(need):
+                self._tick_chunk_debt += need
+            else:
+                return 0  # budget spent; next tick continues
+            # boundary guard: a padded final bucket must not write past
+            # the cache row.  Shift the chunk start left instead and
+            # RE-FEED the overlapped prompt tokens — recomputing K/V
+            # already in the row rewrites identical bytes (position-wise
+            # determinism, docs/serving.md), so the overlap is bit-exact
+            start = min(p0, S - bucket)
+            toks = np.full((1, bucket), self.pad_id, np.int32)
+            end = min(start + bucket, T)
+            toks[0, :end - start] = req.prompt[start:end]
+            final = p0 + csize >= T
+            last_idx = (T - 1 - start) if final else (bucket - 1)
+            key = (jnp.zeros((2,), jnp.uint32) if self.greedy
+                   else jax.random.PRNGKey(req.seed))
+            fn = self._chunk_fn(bucket)
+            caches, tok0, nk = fn(self.variables, self.pool.caches,
+                                  jnp.asarray(toks), slot, start,
+                                  last_idx, key)
+            self.pool.caches = caches
+            req.prefill_pos = p0 + csize
+            self.metrics.bump(sm.PREFILL_TOKENS, bucket)
+            self.metrics.bump(sm.PREFILL_CHUNKS)
+            self._tick_prefill += bucket
+            if final:
+                del self._prefilling[slot]
+                req.state = RequestState.ACTIVE
+                self._tok = self._tok.at[slot].set(tok0)
+                if not self.greedy:
+                    self._keys = self._keys.at[slot].set(nk)
+                self._maybe_insert_prefix(req)
+                self._emit(req, int(tok0))
+                return 1
+
+    def _maybe_insert_prefix(self, req: Request) -> None:
+        """After a completed prefill, capture the prompt's block-aligned
+        prefix K/V into the store (skipped when already indexed)."""
+        if self.prefix is None:
+            return
+        if (self.prefix.max_bytes
+                and self._prefix_row_bytes > self.prefix.max_bytes):
+            return
+        ins = self.prefix.insertable_len(req.prompt,
+                                         salt=self._prefix_salt,
+                                         digests=req._prefix_digs)
+        if ins <= 0:
+            return
+        buf = self._prefix_extract_fn()(self.pool.caches, req.slot, ins)
+        if self.prefix.insert(req.prompt[:ins], buf,
+                              salt=self._prefix_salt,
+                              digests=req._prefix_digs):
+            self.metrics.bump(sm.PREFIX_INSERTIONS)
 
     def _decode_tick(self, active: List[int]) -> int:
         n = self.pool.n_slots
@@ -448,6 +795,14 @@ class ServingEngine:
         for slot in active:
             pos[slot] = self.pool.pos[slot]
             mask[slot] = True
+        # PREFILLING slots ride the decode step masked-off like freed
+        # slots do, but their garbage K/V write must NOT land at pos 0
+        # (it would corrupt the copied prefix / already-written chunks):
+        # aim it at the slot's post-prefill cursor, which the request's
+        # own first real decode overwrites before the causal mask can
+        # ever admit it
+        for slot in self._prefilling:
+            pos[slot] = self.pool.pos[slot]
         caches, nxt, keys = self._decode_step(
             self.variables, self.pool.caches, self._tok,
             jnp.asarray(pos), jnp.asarray(mask), self._keys)
@@ -478,6 +833,7 @@ class ServingEngine:
     def _finish(self, req: Request, state: RequestState) -> None:
         req.state = state
         if req.slot is not None:
+            self._prefilling.pop(req.slot, None)
             self._slot_req[req.slot] = None
             self.pool.free(req.slot)
             req.slot = None
@@ -590,8 +946,13 @@ class ServingEngine:
 
     def compile_counts(self) -> Dict[str, int]:
         """Trace counts of the step programs — steady-state serving must
-        keep ``decode`` at 1 and ``prefill`` at the number of distinct
-        buckets touched (asserted by tests and bench_serve.py)."""
+        keep ``decode`` at 1, ``prefill``/``chunk`` at the number of
+        distinct buckets touched, and the prefix copy/extract programs
+        at 1 each (asserted by tests and bench_serve.py)."""
         return {"decode": self.decode_traces,
                 "prefill": self.prefill_traces,
-                "prefill_buckets": len(self._prefill_fns)}
+                "prefill_buckets": len(self._prefill_fns),
+                "chunk": self.chunk_traces,
+                "chunk_buckets": len(self._chunk_fns),
+                "prefix_copy": self.prefix_copy_traces,
+                "prefix_extract": self.prefix_extract_traces}

@@ -112,7 +112,10 @@ class _ServeHandler(socketserver.BaseRequestHandler):
                             {**engine.metrics.summary(),
                              "compile_counts": engine.compile_counts(),
                              "occupancy": engine.pool.occupancy(),
-                             "queue_depth": engine.scheduler.depth})
+                             "queue_depth": engine.scheduler.depth,
+                             "prefix_cache": (engine.prefix.stats()
+                                              if engine.prefix is not None
+                                              else None)})
                         reply = _encode(0, "", None, payload.encode())
                     elif op == OP_PING:
                         reply = _encode(0, "", None)
@@ -272,6 +275,10 @@ def serve_from_env(env=None) -> int:
         top_k=cfg.serve_top_k, top_p=cfg.serve_top_p,
         eos_id=cfg.serve_eos_id,
         max_queue=cfg.serve_max_queue,
-        prefill_credits=cfg.serve_prefill_credits)
+        prefill_credits=cfg.serve_prefill_credits,
+        chunk=cfg.serve_chunk,
+        prefix_cache=cfg.serve_prefix_cache,
+        prefix_block=cfg.serve_prefix_block,
+        prefix_bytes=cfg.serve_prefix_mb << 20)
     serve(engine, cfg.serve_port)
     return 0

@@ -25,6 +25,16 @@ CANCELLED = "serve.requests_cancelled"
 FAILED = "serve.requests_failed"
 TOKENS = "serve.tokens_generated"
 PREFILL_TOKENS = "serve.prefill_tokens"
+# chunked prefill (serving/engine.py chunk>0): one bump per jitted
+# chunk call — with PREFILL_TOKENS this gives padded tokens/chunk
+PREFILL_CHUNKS = "serve.prefill_chunks"
+# prefix-reuse KV cache (serving/prefix.py): lookup outcomes per
+# admission and the tokens whose prefill was skipped by a device-side
+# K/V copy (the FLOP saving PREFILL_TOKENS no longer contains)
+PREFIX_HITS = "serve.prefix_hits"
+PREFIX_MISSES = "serve.prefix_misses"
+PREFIX_HIT_TOKENS = "serve.prefix_hit_tokens"
+PREFIX_INSERTIONS = "serve.prefix_insertions"
 # per-tick value tracks (gauges, not monotonic)
 OCCUPANCY = "serve.batch_occupancy"
 QUEUE_DEPTH = "serve.queue_depth"

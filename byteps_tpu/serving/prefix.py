@@ -1,0 +1,307 @@
+"""Prefix-reuse KV cache: refcounted, LRU/byte-budgeted shared prefixes.
+
+SGLang's RadixAttention observation, adapted to the slot-pool engine:
+system-prompt-heavy traffic recomputes identical prompt K/V over and
+over, and for a causal model the K/V of a shared token prefix is
+*position-for-position identical* across requests — so it can be copied
+device-side instead of recomputed, and the copy is bit-exact by
+construction (the bytes are moved, not re-derived; docs/serving.md
+"Prefix-reuse KV cache").
+
+Design, sized for the static-shape engine:
+
+  * **Entries are full cache-row buffers.**  An inserted prefix is the
+    request's slot row with positions ``>= length`` zero-masked — every
+    entry therefore has the SAME pytree shapes (``[1, max_seq, ...]``
+    per layer), so the engine's jitted extract and copy functions trace
+    exactly once each, the same compile discipline as the decode step.
+    The cost is bytes: a short prefix pays a full row's storage, which
+    the byte budget accounts honestly.
+  * **One buffer, many index keys.**  The lookup index maps a *rolling
+    block hash* to ``(entry, boundary_length)``: inserting a prefix of
+    ``k`` blocks registers every boundary ``1..k`` against the same
+    buffer, so a request sharing only the first block of a longer
+    cached prefix still hits.  Copying more rows than the match length
+    is safe — rows past the boundary are never attended before the
+    request's own prefill/decode overwrites them (the engine's
+    overwrite-before-attend invariant, slots.py).
+  * **Hashes are verified.**  A match compares the actual stored tokens
+    before it is returned; a digest collision degrades to a miss, never
+    to wrong K/V.
+  * **Refcounts pin, LRU evicts.**  ``acquire``/``release`` bracket an
+    entry's use (the engine pins across the device copy); eviction
+    walks least-recently-matched entries with zero refs until the store
+    fits ``max_bytes``.
+
+The usable match length is capped at ``len(prompt) - 1``: the engine
+must still run at least one prefill position to produce the first
+token's logits.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["PrefixCache", "PrefixEntry", "weights_fingerprint"]
+
+
+def _tree_bytes(tree) -> int:
+    return sum(int(x.nbytes) for x in jax.tree_util.tree_leaves(tree))
+
+
+def weights_fingerprint(variables) -> bytes:
+    """Order-stable digest of a parameter pytree: leaf paths, shapes,
+    dtypes, and a cheap value sample (per-leaf float32 sum plus head
+    and tail elements).  Engines fold it into their prefix-hash salt,
+    so engines serving *different weights* through one shared
+    ``PrefixCache`` occupy disjoint key spaces — K/V computed under one
+    checkpoint can never be matched to a prompt served under another.
+    Costs a few scalar readbacks per leaf, once per engine."""
+    h = hashlib.blake2b(digest_size=16)
+    for path, leaf in jax.tree_util.tree_flatten_with_path(variables)[0]:
+        arr = jnp.asarray(leaf)
+        flat = arr.reshape(-1)
+        h.update(jax.tree_util.keystr(path).encode())
+        h.update(f"{arr.shape}{arr.dtype}".encode())
+        h.update(np.asarray(flat[:8]).tobytes())
+        h.update(np.asarray(flat[-8:]).tobytes())
+        h.update(np.asarray(jnp.sum(flat.astype(jnp.float32))).tobytes())
+    return h.digest()
+
+
+class PrefixEntry:
+    """One stored prefix: a full cache-row buffer plus the tokens it
+    holds.  ``refs`` pins the entry against eviction while the engine
+    copies it device-side."""
+
+    __slots__ = ("buffer", "tokens", "length", "nbytes", "refs", "keys",
+                 "stamp", "salt")
+
+    def __init__(self, buffer, tokens: np.ndarray, length: int,
+                 nbytes: int, stamp: int, salt: bytes = b""):
+        self.buffer = buffer
+        self.tokens = tokens          # [length] int32, verified on match
+        self.length = length          # block-aligned token count stored
+        self.nbytes = nbytes
+        self.refs = 0
+        # (digest, boundary_length) index keys referencing this entry
+        self.keys: List[Tuple[bytes, int]] = []
+        self.stamp = stamp            # LRU clock (monotonic per touch)
+        self.salt = salt              # inserter's key-space (weights)
+
+
+class PrefixCache:
+    """Block-aligned KV-prefix store keyed by a rolling token hash.
+
+    ``block`` is the match granularity in tokens (prefixes are stored
+    and matched at multiples of it); ``max_bytes`` bounds the summed
+    buffer bytes (0 = unbounded).  Thread-safe; the engine additionally
+    serializes all calls under its tick lock.
+    """
+
+    def __init__(self, block: int = 16, max_bytes: int = 256 << 20):
+        if block < 1:
+            raise ValueError(f"block must be >= 1, got {block}")
+        if max_bytes < 0:
+            raise ValueError(f"max_bytes must be >= 0, got {max_bytes}")
+        self.block = block
+        self.max_bytes = max_bytes
+        self._index: Dict[bytes, Tuple[PrefixEntry, int]] = {}
+        self._entries: List[PrefixEntry] = []
+        self._clock = itertools.count(1)
+        self._lock = threading.RLock()
+        self.hits = 0
+        self.misses = 0
+        self.insertions = 0
+        self.evictions = 0
+
+    # ------------------------------------------------------------- hashing
+
+    def _digests(self, tokens: np.ndarray, nblocks: int,
+                 salt: bytes = b"") -> List[bytes]:
+        """Rolling per-boundary digests: ``h_j = H(h_{j-1} || block_j)``
+        seeded with ``salt``, so the j-block digest commits to every
+        token before it AND to the caller's key space (engines salt
+        with a weights fingerprint — see :func:`weights_fingerprint`)."""
+        toks = np.ascontiguousarray(np.asarray(tokens, np.int32))
+        out: List[bytes] = []
+        h = salt
+        B = self.block
+        for j in range(nblocks):
+            h = hashlib.blake2b(h + toks[j * B:(j + 1) * B].tobytes(),
+                                digest_size=16).digest()
+            out.append(h)
+        return out
+
+    def digests_for(self, prompt, salt: bytes = b"") -> List[bytes]:
+        """All rolling block digests of ``prompt`` (``len(prompt) //
+        block`` of them).  Callers issuing several lookups for one
+        prompt (the engine: match at admit, then insertable_len and
+        insert after prefill) compute this once and pass it via
+        ``digests=`` — each call then skips its own hashing pass, which
+        otherwise runs one blake2b per block per call on the engine's
+        tick thread."""
+        toks = np.asarray(prompt, np.int32).reshape(-1)
+        return self._digests(toks, int(toks.shape[0]) // self.block,
+                             salt)
+
+    # -------------------------------------------------------------- lookup
+
+    def match(self, prompt, salt: bytes = b"",
+              digests: Optional[List[bytes]] = None,
+              ) -> Optional[Tuple[PrefixEntry, int]]:
+        """Longest cached block-aligned prefix of ``prompt`` usable for
+        serving: ``(entry, length)`` with ``length <= len(prompt) - 1``
+        (at least one position must remain to prefill for the first
+        token's logits), or None.  Touches the entry's LRU stamp.
+        Only entries inserted under the same ``salt`` can match.
+        ``digests`` (from :meth:`digests_for`, same prompt and salt)
+        skips the hashing pass."""
+        toks = np.asarray(prompt, np.int32).reshape(-1)
+        max_blocks = (int(toks.shape[0]) - 1) // self.block
+        if max_blocks < 1:
+            with self._lock:
+                self.misses += 1
+            return None
+        if digests is not None and len(digests) >= max_blocks:
+            digs = digests[:max_blocks]
+        else:
+            digs = self._digests(toks, max_blocks, salt)
+        with self._lock:
+            for j in range(max_blocks, 0, -1):
+                found = self._index.get(digs[j - 1])
+                if found is None:
+                    continue
+                entry, blen = found
+                if not np.array_equal(entry.tokens[:blen], toks[:blen]):
+                    continue  # digest collision -> treat as a miss
+                entry.stamp = next(self._clock)
+                self.hits += 1
+                return entry, blen
+            self.misses += 1
+            return None
+
+    def insertable_len(self, prompt, salt: bytes = b"",
+                       digests: Optional[List[bytes]] = None) -> int:
+        """Block-aligned length a post-prefill insert of ``prompt``
+        would store, or 0 when nothing new would land (prompt shorter
+        than a block, or its full block-aligned prefix is already
+        indexed).  ``digests`` as in :meth:`match`."""
+        toks = np.asarray(prompt, np.int32).reshape(-1)
+        nblocks = int(toks.shape[0]) // self.block
+        if nblocks < 1:
+            return 0
+        if digests is not None and len(digests) >= nblocks:
+            digs = digests[:nblocks]
+        else:
+            digs = self._digests(toks, nblocks, salt)
+        with self._lock:
+            if digs[-1] in self._index:
+                return 0
+        return nblocks * self.block
+
+    # -------------------------------------------------------------- insert
+
+    def insert(self, tokens, buffer, salt: bytes = b"",
+               digests: Optional[List[bytes]] = None) -> bool:
+        """Store ``buffer`` (a full cache-row pytree whose rows
+        ``>= len(tokens)`` are zero-masked) under every block boundary
+        of ``tokens``, keyed in ``salt``'s key space.  ``len(tokens)``
+        must be block-aligned (callers slice with
+        :meth:`insertable_len`).  Returns False when nothing was stored
+        (already indexed, or larger than the whole budget).
+        ``digests`` as in :meth:`match` (rolling digests of the full
+        prompt work for its sliced prefix — digest ``j`` commits only
+        to blocks ``<= j``)."""
+        toks = np.asarray(tokens, np.int32).reshape(-1).copy()
+        length = int(toks.shape[0])
+        if length < self.block or length % self.block:
+            raise ValueError(
+                f"insert length {length} is not a positive multiple of "
+                f"block {self.block}")
+        nblocks = length // self.block
+        if digests is not None and len(digests) >= nblocks:
+            digs = digests[:nblocks]
+        else:
+            digs = self._digests(toks, nblocks, salt)
+        nbytes = _tree_bytes(buffer)
+        with self._lock:
+            if digs[-1] in self._index:
+                return False  # a concurrent insert won the race
+            if self.max_bytes and nbytes > self.max_bytes:
+                return False  # a single entry cannot fit the budget
+            entry = PrefixEntry(buffer, toks, length, nbytes,
+                                next(self._clock), salt)
+            for j in range(1, nblocks + 1):
+                if digs[j - 1] not in self._index:
+                    self._index[digs[j - 1]] = (entry, j * self.block)
+                    entry.keys.append((digs[j - 1], j * self.block))
+            self._entries.append(entry)
+            self.insertions += 1
+            self._evict_to_budget_locked()
+            return True
+
+    # ------------------------------------------------------------ eviction
+
+    def acquire(self, entry: PrefixEntry) -> None:
+        """Pin ``entry`` against eviction (bracket a device copy)."""
+        with self._lock:
+            entry.refs += 1
+
+    def release(self, entry: PrefixEntry) -> None:
+        with self._lock:
+            if entry.refs < 1:
+                raise ValueError("release() without matching acquire()")
+            entry.refs -= 1
+
+    def _evict_to_budget_locked(self) -> None:
+        if not self.max_bytes:
+            return
+        while self.total_bytes > self.max_bytes:
+            victims = [e for e in self._entries if e.refs == 0]
+            if not victims:
+                return  # everything pinned; retry on the next insert
+            victim = min(victims, key=lambda e: e.stamp)
+            self._entries.remove(victim)
+            for digest, blen in victim.keys:
+                self._index.pop(digest, None)
+                # a boundary first registered by the victim may be
+                # covered by a LATER entry that shares its blocks (insert
+                # only registers boundaries it does not already find):
+                # re-point the key at a surviving cover, or shared-prefix
+                # lookups would miss K/V the store still holds
+                for heir in self._entries:
+                    if (heir.salt == victim.salt
+                            and heir.length >= blen and np.array_equal(
+                                heir.tokens[:blen], victim.tokens[:blen])):
+                        self._index[digest] = (heir, blen)
+                        heir.keys.append((digest, blen))
+                        break
+            self.evictions += 1
+
+    # ---------------------------------------------------------- inspection
+
+    @property
+    def total_bytes(self) -> int:
+        with self._lock:
+            return sum(e.nbytes for e in self._entries)
+
+    @property
+    def entry_count(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            total = sum(e.nbytes for e in self._entries)
+            return {"hits": self.hits, "misses": self.misses,
+                    "insertions": self.insertions,
+                    "evictions": self.evictions,
+                    "entries": len(self._entries), "bytes": total}

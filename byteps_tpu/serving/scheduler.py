@@ -122,6 +122,31 @@ class ServeScheduler:
         """Return a granted task's credits (end of the engine tick)."""
         self._q.report_finish(task)
 
+    def take_credits(self, n: int) -> bool:
+        """Debit ``n`` credits for prefill work granted outside the
+        queue — a chunked-prefill *continuation* chunk of an
+        already-admitted request shares this pool with queued
+        admissions, so one budget bounds the total prefill work
+        between consecutive decode passes.  Pair every success with
+        :meth:`return_credits` at end of tick."""
+        return self._q.try_debit(n)
+
+    def return_credits(self, n: int) -> None:
+        """Return directly-debited continuation credits."""
+        self._q.credit(n)
+
+    def remove(self, task: PrefillTask) -> bool:
+        """Eagerly drop a still-queued task (cancellation before any
+        grant): frees its queue-depth immediately instead of letting
+        the dead request sit in the admission queue and consume a
+        grant.  False when the task was already granted — the engine
+        then retires it at grant time as before."""
+        if not self._q.remove(task):
+            return False
+        with self._lock:
+            self._depth -= 1
+        return True
+
     def drain_pending(self) -> List[PrefillTask]:
         """Pop EVERY queued task regardless of credits — the engine's
         failure path must reach requests a credit-bounded ``admit``

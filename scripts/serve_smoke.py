@@ -10,8 +10,17 @@ under arrival orders the fast tier-1 test cannot reach.  Both greedy
 and seeded-sampling engines run; the engine's decode program must not
 retrace after warmup.
 
+``--prefix-share`` runs the same randomized-arrival check on a
+shared-system-prompt workload with chunked prefill + the prefix-reuse
+KV cache enabled: every request repeats one block-aligned prefix with
+a unique tail, and the outputs must be token-identical BOTH to the
+sequential ``generate()`` baselines and to a cache-off engine run of
+the same jobs — prefix reuse copies K/V bytes instead of recomputing
+them, so parity is exact, not approximate.
+
 Usage:
     python scripts/serve_smoke.py [--requests 12] [--seed 0]
+    python scripts/serve_smoke.py --prefix-share
 
 Wired into CI as a ``slow``-marked pytest (tests/test_serve_smoke.py)
 so tier-1 stays fast.
@@ -32,7 +41,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
 def run(requests: int = 12, seed: int = 0, n_slots: int = 4,
-        temperature: float = 0.0, verbose: bool = True) -> dict:
+        temperature: float = 0.0, verbose: bool = True,
+        prefix_share: bool = False) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -49,12 +59,20 @@ def run(requests: int = 12, seed: int = 0, n_slots: int = 4,
                            jnp.zeros((1, 8), jnp.int32))
 
     rng = random.Random(seed)
+    shared = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(999), (24,), 0, 61), np.int32)
     jobs = []
     for i in range(requests):
-        T = rng.randint(3, 24)
         M = rng.randint(2, 12)
-        prompt = np.asarray(jax.random.randint(
-            jax.random.PRNGKey(1000 + i), (T,), 0, 61), np.int32)
+        if prefix_share:
+            T = rng.randint(1, 12)
+            tail = np.asarray(jax.random.randint(
+                jax.random.PRNGKey(1000 + i), (T,), 0, 61), np.int32)
+            prompt = np.concatenate([shared, tail])
+        else:
+            T = rng.randint(3, 24)
+            prompt = np.asarray(jax.random.randint(
+                jax.random.PRNGKey(1000 + i), (T,), 0, 61), np.int32)
         jobs.append({"prompt": prompt, "max_new": M, "seed": 7 * i + 1})
 
     # sequential baselines, one prompt at a time (B=1) — per-engine-mode
@@ -69,9 +87,26 @@ def run(requests: int = 12, seed: int = 0, n_slots: int = 4,
                        job["max_new"], temperature=temperature, **kw)
         baselines.append(np.asarray(out["tokens"])[0])
 
+    engine_kw = dict(sample_kw)
+    off_out = None
+    if prefix_share:
+        engine_kw.update(chunk=8, prefix_cache=True, prefix_block=8)
+        # cache-OFF reference run of the same jobs (chunked, no prefix
+        # store): the cache-on engine must reproduce it token for token
+        off = ServingEngine(
+            model, variables, n_slots=n_slots, max_seq=cfg.max_seq_len,
+            temperature=temperature, metrics=ServeMetrics(), chunk=8,
+            **sample_kw)
+        off.start()
+        off_reqs = [off.submit(j["prompt"], j["max_new"], seed=j["seed"])
+                    for j in jobs]
+        off.drain(timeout=300)
+        off.stop()
+        off_out = [r.result() for r in off_reqs]
+
     engine = ServingEngine(
         model, variables, n_slots=n_slots, max_seq=cfg.max_seq_len,
-        temperature=temperature, metrics=ServeMetrics(), **sample_kw)
+        temperature=temperature, metrics=ServeMetrics(), **engine_kw)
     engine.start()
     results = [None] * requests
     errors = []
@@ -104,10 +139,17 @@ def run(requests: int = 12, seed: int = 0, n_slots: int = 4,
             mismatches += 1
             if verbose:
                 print(f"MISMATCH req {i}: got {got} want {base}")
+        if off_out is not None and not np.array_equal(got, off_out[i]):
+            mismatches += 1
+            if verbose:
+                print(f"MISMATCH vs cache-off req {i}: got {got} "
+                      f"want {off_out[i]}")
     counts = engine.compile_counts()
     stats = {"requests": requests, "mismatches": mismatches,
              "decode_traces": counts["decode"],
              "prefill_buckets": counts["prefill_buckets"],
+             "chunk_buckets": counts["chunk_buckets"],
+             "prefix_copy_traces": counts["prefix_copy"],
              "temperature": temperature,
              **engine.metrics.snapshot()}
     if verbose:
@@ -120,12 +162,18 @@ def main(argv=None) -> int:
     ap.add_argument("--requests", type=int, default=12)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--prefix-share", action="store_true",
+                    help="shared-prefix workload with chunked prefill "
+                         "+ prefix cache, parity vs a cache-off run")
     args = ap.parse_args(argv)
     ok = True
     for temp in (0.0, 0.8):
         stats = run(requests=args.requests, seed=args.seed,
-                    n_slots=args.slots, temperature=temp)
+                    n_slots=args.slots, temperature=temp,
+                    prefix_share=args.prefix_share)
         ok = ok and stats["mismatches"] == 0 and stats["decode_traces"] == 1
+        if args.prefix_share:
+            ok = ok and stats.get("serve.prefix_hits", 0) > 0
     print("serve_smoke:", "OK" if ok else "FAILED")
     return 0 if ok else 1
 

@@ -307,6 +307,33 @@ def test_cancel_queued_and_active(tiny, prompts, greedy_eng):
     assert r0.tokens and not r1.tokens  # r0 got its prefill token, r1 none
 
 
+def test_cancel_queued_drops_eagerly_without_a_tick(tiny, prompts):
+    """Cancelling a still-QUEUED request removes it from the admission
+    queue at cancel() time: queue depth frees immediately (no tick
+    thread involved) and no grant is ever consumed by the corpse."""
+    _, model, variables = tiny
+    eng = ServingEngine(model, variables, n_slots=1, max_seq=64,
+                        max_queue=2, metrics=ServeMetrics())
+    r0 = eng.submit(prompts[0], 16)
+    eng.step()  # r0 occupies the only slot
+    r1 = eng.submit(prompts[1], 4)
+    assert eng.scheduler.depth == 1
+    eng.cancel(r1)
+    # retired synchronously: done before any further tick runs
+    assert r1.done and r1.state.value == "cancelled"
+    assert eng.scheduler.depth == 0
+    assert r1.result().size == 0
+    assert eng.metrics.get(sm.CANCELLED) == 1
+    # the freed depth is usable again, and granting skips nothing
+    r2 = eng.submit(prompts[2], 2)
+    eng.cancel(r0)
+    eng.drain(timeout=120)
+    assert r2.state.value == "done" and len(r2.result()) == 2
+    # double-cancel of an already-finished request is a no-op
+    eng.cancel(r1)
+    assert eng.metrics.get(sm.CANCELLED) == 2  # r0 + r1, not r1 twice
+
+
 def test_tick_failure_fails_requests_loudly(tiny, prompts):
     """A tick-thread exception must not look like a hang: the in-flight
     request, queued requests beyond the credit budget (which a

@@ -198,7 +198,7 @@ class _ManualShard:
 
 def test_shard_worker_window_bounds_inflight():
     shard = _ManualShard()
-    w = ShardWorker(shard.connect, window=2, recv_timeout=5.0)
+    w = ShardWorker(shard.connect, window=2, recv_timeout=60.0)
     try:
         pend = [w.submit(_encode_buffers(ps_server.OP_PING, f"r{i}", None),
                          key=i) for i in range(5)]
@@ -209,12 +209,16 @@ def test_shard_worker_window_bounds_inflight():
         assert shard.pending_bytes() == 0  # window=2: r2 is NOT on the wire
         shard.reply_ok()  # ack r0 -> frees a slot
         assert shard.read_frame_name() == "r2"
-        for _ in range(4):
-            shard.reply_ok()
+        # Ack strictly like a real server: only requests already read off
+        # the wire.  Acking ahead races the sender thread — the recv loop
+        # can drain the burst before r3 is in flight and (correctly) kill
+        # the connection as a protocol violation.
+        shard.reply_ok()  # ack r1
         assert shard.read_frame_name() == "r3"
-        shard.reply_ok()
+        shard.reply_ok()  # ack r2
         assert shard.read_frame_name() == "r4"
-        shard.reply_ok()
+        shard.reply_ok()  # ack r3
+        shard.reply_ok()  # ack r4
         for p in pend:
             status, _, _, _ = w.wait(p, 5.0)
             assert status == 0
@@ -227,7 +231,7 @@ def test_shard_worker_priority_order_on_wire():
     """Frames queued while the window is full go out (priority desc,
     key asc) — the ScheduledQueue rule — not submission order."""
     shard = _ManualShard()
-    w = ShardWorker(shard.connect, window=1, recv_timeout=5.0)
+    w = ShardWorker(shard.connect, window=1, recv_timeout=60.0)
     try:
         first = w.submit(_encode_buffers(ps_server.OP_PING, "first", None))
         shard.accept()
@@ -259,7 +263,7 @@ def test_shard_worker_timeout_aborts_connection():
     matching cannot skip a frame) and surface as socket.timeout; the
     next submit transparently reconnects."""
     shard = _ManualShard()
-    w = ShardWorker(shard.connect, window=2, recv_timeout=5.0)
+    w = ShardWorker(shard.connect, window=2, recv_timeout=60.0)
     try:
         p = w.submit(_encode_buffers(ps_server.OP_PING, "hang", None))
         shard.accept()
@@ -287,7 +291,7 @@ def test_shard_worker_reset_fails_whole_window():
     onto the next connection."""
     shard = _ManualShard()
     resets = []
-    w = ShardWorker(shard.connect, window=3, recv_timeout=5.0,
+    w = ShardWorker(shard.connect, window=3, recv_timeout=60.0,
                     on_reset=lambda err, n: resets.append(n))
     try:
         pend = [w.submit(_encode_buffers(ps_server.OP_PING, f"q{i}", None),
