@@ -1,0 +1,315 @@
+"""Observability overhead bench: full instrumentation on vs off.
+
+The PR-6 layer (docs/observability.md) is meant to be *always-on*
+visibility — registry counters on every wire frame, gauges on every
+window transition, per-RPC trace ids, chrome-trace mirroring, and a
+live HTTP scrape endpoint.  This bench measures what that costs on the
+two hot paths that carry it:
+
+  * **wire** (the train-step transport): a fixed batch of
+    ``RemoteStore.push_pull`` steps against 2 in-process PS shards;
+  * **serve**: a burst of requests through the continuous-batching
+    engine.
+
+Measurement protocol.  This 2-vCPU container cannot resolve a 3%
+effect with whole-system timing: interleaved A/A runs of the OFF
+configuration disagree by 10-40% wall time AND 2x in process-CPU time
+(throttling, scheduling, syscall-count luck), so an on-vs-off wall
+comparison only bounds the overhead below the host's noise floor.
+Each leg therefore reports two numbers:
+
+  * ``overhead_pct`` (asserted < 3%) — the **direct instrumentation
+    cost**: the per-event cost of the real hot-path primitives
+    (``Tracer.complete`` appends, trace-id minting + context), measured
+    single-threaded min-of-reps (CPU-bound, so robust on this host),
+    multiplied by the *actual* per-step event count read back from the
+    trace file an ON block wrote, expressed against the median OFF
+    step time.  Registry counter/gauge updates are excluded from the
+    delta because they run in OFF mode too (they are unconditionally
+    on by design); trace-file rollover I/O is amortized outside the
+    hot path and flushes land outside the timed window.
+  * ``wall_ab_pct`` + ``aa_noise_pct`` (informational) — the paired
+    wall-clock on/off median ratio and the same statistic for two OFF
+    runs (the noise floor).  Expect ``wall_ab_pct`` to be within the
+    noise floor; if it ever clears it, the analytic number is wrong
+    and the assert should be distrusted.
+
+Prints ONE JSON line per path and append-archives rows into
+BENCH_OBS.json (bench_util.archive_rows — reruns replace their own
+rows).  Acceptance (ISSUE 6) is pinned by the slow test
+tests/test_observability.py::test_bench_obs_overhead.  Runs anywhere:
+
+    JAX_PLATFORMS=cpu python bench_obs.py [--steps 60 --pairs 4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import tempfile
+import time
+
+from bench_util import archive_rows
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def _median(vals):
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+def _pair_pct(offs, ons):
+    """Median of adjacent-pair on/off ratios, as a percent."""
+    ratios = [on / off for off, on in zip(offs, ons)]
+    return round((_median(ratios) - 1.0) * 100, 2)
+
+
+def _reset_process_state(trace_path: str) -> None:
+    """Point the process at a fresh config/tracer for one mode.  The
+    metrics registry deliberately stays — counters are monotonic and
+    always-on; only the *surfacing* differs between modes."""
+    from byteps_tpu.common.config import reset_config
+    from byteps_tpu.common.tracing import reset_tracer
+
+    if trace_path:
+        os.environ["BYTEPS_TRACE_PATH"] = trace_path
+    else:
+        os.environ.pop("BYTEPS_TRACE_PATH", None)
+    reset_config()
+    reset_tracer()
+
+
+def _primitive_costs_us(td: str, n: int = 20000, reps: int = 3):
+    """Single-threaded cost of the two primitives the ON-mode delta is
+    made of: one trace-event append (``Tracer.complete`` — the
+    representative; counter/instant events build the same dict + lock +
+    append) and one per-op trace-id mint + context enter/exit.
+    Min-of-reps: the loop is pure CPU, so the minimum is the true cost
+    and throttle spikes only ever inflate it."""
+    from byteps_tpu.common.tracing import Tracer
+    from byteps_tpu.observability.trace import trace_context
+
+    t = Tracer(path=os.path.join(td, "ubench.json"), max_events=10 ** 9)
+    ev_cost = mint_cost = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            t.complete("w", "wire", 1.0, 0.001, trace_id="0011223344556677")
+        ev_cost = min(ev_cost, (time.perf_counter() - t0) / n)
+        t._events.clear()
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with trace_context():
+                pass
+        mint_cost = min(mint_cost, (time.perf_counter() - t0) / n)
+    return ev_cost * 1e6, mint_cost * 1e6
+
+
+# ------------------------------------------------------------------ wire leg
+
+
+def bench_wire(steps: int = 60, pairs: int = 4, dim: int = 16384,
+               tensors: int = 4, shards: int = 2):
+    from byteps_tpu.common.tracing import get_tracer
+    from byteps_tpu.engine import ps_server
+    from byteps_tpu.observability.export import load_trace_events
+
+    servers = []
+    for _ in range(shards):
+        srv, _ = ps_server.serve(0, host="127.0.0.1", use_native=False,
+                                 in_thread=True)
+        servers.append(srv)
+    addrs = [f"127.0.0.1:{s.server_address[1]}" for s in servers]
+    grads = {f"layer{i}": np.full((dim,), 0.01, np.float32)
+             for i in range(tensors)}
+    seq = [0]
+
+    def run_mode(on: bool, td: str, scrape) -> tuple:
+        seq[0] += 1
+        trace_path = (os.path.join(td, f"wire_trace_{seq[0]}.json")
+                      if on else "")
+        _reset_process_state(trace_path)
+        store = ps_server.RemoteStore(addrs)
+        for name, g in grads.items():
+            store.init_tensor(name, g)
+        if on:
+            store.record_clock_offsets(samples=2)
+        for name, g in grads.items():  # warm the sockets/workers
+            store.push_pull(name, g)
+        t0 = time.perf_counter()
+        for i in range(steps):
+            for name, g in grads.items():
+                store.push_pull(name, g)
+            if on and i == steps // 2:
+                scrape()  # one live scrape inside the timed window
+        elapsed = time.perf_counter() - t0
+        store.close()
+        events = 0
+        if on:
+            get_tracer().flush()
+            events = len(load_trace_events(trace_path))
+        return elapsed / steps, events
+
+    with tempfile.TemporaryDirectory() as td:
+        import urllib.request
+
+        from byteps_tpu.observability.scrape import start_metrics_server
+
+        http = start_metrics_server(0, host="127.0.0.1", role="bench")
+        url = f"http://127.0.0.1:{http.port}/metrics"
+
+        def scrape():
+            with urllib.request.urlopen(url, timeout=5) as r:
+                r.read()
+
+        try:
+            offs, ons, offs2, ev_counts = [], [], [], []
+            for _ in range(pairs):
+                offs.append(run_mode(False, td, scrape)[0])
+                t, ev = run_mode(True, td, scrape)
+                ons.append(t)
+                ev_counts.append(ev)
+                offs2.append(run_mode(False, td, scrape)[0])
+            ev_cost_us, mint_cost_us = _primitive_costs_us(td)
+        finally:
+            # start_metrics_server() returns an unmanaged server (the
+            # module-global stop_ helper only stops maybe_-started ones)
+            http.shutdown()
+            http.server_close()
+            _reset_process_state("")
+            for srv in servers:
+                srv.shutdown()
+
+    step_ms_off = _median(offs + offs2) * 1e3
+    # events/step overcounts in the ON path's favor: the count includes
+    # the un-timed setup's events (init, clock offsets, warmup)
+    ev_per_step = _median(ev_counts) / steps
+    overhead_us = ev_per_step * ev_cost_us + tensors * mint_cost_us
+    return {
+        "metric": "obs_overhead_wire",
+        "overhead_pct": round(overhead_us / (step_ms_off * 1e3) * 100, 3),
+        "step_ms_off": round(step_ms_off, 4),
+        "instrumentation_us_per_step": round(overhead_us, 2),
+        "trace_events_per_step": round(ev_per_step, 1),
+        "event_cost_us": round(ev_cost_us, 3),
+        "mint_cost_us": round(mint_cost_us, 3),
+        "wall_ab_pct": _pair_pct(offs, ons),
+        "aa_noise_pct": _pair_pct(offs, offs2),
+        "config": {"steps": steps, "pairs": pairs, "dim": dim,
+                   "tensors": tensors, "shards": shards,
+                   "on": "trace_path + trace ids + clock offsets + "
+                         "one live /metrics scrape per block"},
+    }
+
+
+# ----------------------------------------------------------------- serve leg
+
+
+def bench_serve_path(requests: int = 8, tokens: int = 24, pairs: int = 4,
+                     prompt_len: int = 16, d_model: int = 128,
+                     layers: int = 2, vocab: int = 256):
+    import jax.numpy as jnp
+
+    from byteps_tpu.common.tracing import get_tracer
+    from byteps_tpu.models.transformer import Transformer, TransformerConfig
+    from byteps_tpu.observability.export import load_trace_events
+    from byteps_tpu.serving import ServeMetrics, ServingEngine
+
+    cfg = TransformerConfig(vocab_size=vocab, num_layers=layers,
+                            num_heads=4, d_model=d_model, d_ff=4 * d_model,
+                            max_seq_len=256, dtype=jnp.float32)
+    model = Transformer(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    prompts = [np.asarray(jax.random.randint(
+        jax.random.PRNGKey(100 + i), (prompt_len,), 0, vocab), np.int32)
+        for i in range(requests)]
+    seq = [0]
+
+    def run_mode(on: bool, td: str) -> tuple:
+        seq[0] += 1
+        trace_path = (os.path.join(td, f"serve_trace_{seq[0]}.json")
+                      if on else "")
+        _reset_process_state(trace_path)
+        engine = ServingEngine(model, variables, n_slots=4, max_seq=256,
+                               temperature=0.0, metrics=ServeMetrics())
+        engine.start()
+        engine.submit(prompts[0], tokens)   # warm compile caches
+        engine.drain(timeout=600)
+        t0 = time.perf_counter()
+        for p in prompts:
+            engine.submit(p, tokens)
+        engine.drain(timeout=600)
+        elapsed = time.perf_counter() - t0
+        engine.stop()
+        events = 0
+        if on:
+            get_tracer().flush()
+            events = len(load_trace_events(trace_path))
+        return elapsed, events
+
+    with tempfile.TemporaryDirectory() as td:
+        try:
+            offs, ons, offs2, ev_counts = [], [], [], []
+            for _ in range(pairs):
+                offs.append(run_mode(False, td)[0])
+                t, ev = run_mode(True, td)
+                ons.append(t)
+                ev_counts.append(ev)
+                offs2.append(run_mode(False, td)[0])
+            ev_cost_us, mint_cost_us = _primitive_costs_us(td)
+        finally:
+            _reset_process_state("")
+
+    burst_s_off = _median(offs + offs2)
+    ev_per_burst = _median(ev_counts)  # includes the un-timed warmup's
+    overhead_us = ev_per_burst * ev_cost_us + requests * mint_cost_us
+    return {
+        "metric": "obs_overhead_serve",
+        "overhead_pct": round(overhead_us / (burst_s_off * 1e6) * 100, 3),
+        "burst_s_off": round(burst_s_off, 4),
+        "instrumentation_us_per_burst": round(overhead_us, 2),
+        "trace_events_per_burst": round(ev_per_burst, 1),
+        "event_cost_us": round(ev_cost_us, 3),
+        "mint_cost_us": round(mint_cost_us, 3),
+        "wall_ab_pct": _pair_pct(offs, ons),
+        "aa_noise_pct": _pair_pct(offs, offs2),
+        "config": {"requests": requests, "tokens": tokens, "pairs": pairs,
+                   "prompt_len": prompt_len, "d_model": d_model,
+                   "layers": layers,
+                   "on": "trace_path tracing + per-request trace ids"},
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--pairs", type=int, default=4)
+    ap.add_argument("--requests", type=int, default=8)
+    ap.add_argument("--tokens", type=int, default=24)
+    ap.add_argument("--wire-only", action="store_true")
+    ap.add_argument("--serve-only", action="store_true")
+    ap.add_argument("--out", default="BENCH_OBS.json")
+    ap.add_argument("--no-archive", action="store_true")
+    args = ap.parse_args(argv)
+    rows = []
+    if not args.serve_only:
+        rows.append(bench_wire(steps=args.steps, pairs=args.pairs))
+        print(json.dumps(rows[-1]), flush=True)
+    if not args.wire_only:
+        rows.append(bench_serve_path(requests=args.requests,
+                                     tokens=args.tokens, pairs=args.pairs))
+        print(json.dumps(rows[-1]), flush=True)
+    if not args.no_archive:
+        archive_rows(rows, args.out)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

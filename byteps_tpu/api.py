@@ -120,6 +120,14 @@ def init(
                 "coordinator vars) for a multi-host run", cfg.num_worker,
             )
         _dispatcher.start_engine(mesh, _state.reduce_axes)
+        # live scrape endpoint for the worker role (BYTEPS_METRICS_PORT,
+        # off by default) — every role has the same /metrics + /healthz
+        # surface (docs/observability.md)
+        from .observability.scrape import maybe_start_metrics_server
+
+        maybe_start_metrics_server(
+            role="worker",
+            health_fn=lambda: {"devices": jax.local_device_count()})
         _state.initialized = True
         bps_log.info(
             "byteps_tpu initialized: mesh %s, reduce axes %s",
@@ -143,7 +151,9 @@ def shutdown() -> None:
 
         close_async_store()
         from .common.tracing import reset_tracer
+        from .observability.scrape import stop_metrics_server
 
+        stop_metrics_server()
         reset_tracer()  # flushes the chrome trace if enabled
         reset_config()
 

@@ -108,6 +108,18 @@ class Config:
     debug_sample_tensor: str = ""
     trace_path: str = ""  # chrome-trace output ("" = disabled)
 
+    # --- observability (byteps_tpu/observability/; docs/observability.md.
+    # The reference's story stops at per-process trace files — these
+    # knobs add the live scrape surface and cross-process correlation) -
+    # HTTP /metrics + /healthz port on every role; 0 = off
+    metrics_port: int = 0
+    # Tracer in-memory event bound before rollover-flush to trace_path
+    # (0 = unbounded, the pre-PR-6 leak)
+    trace_buffer: int = 100_000
+    # per-RPC trace ids on the wire frame: None = auto (on iff
+    # trace_path tracing is on); forced via BYTEPS_TRACE_RPC
+    trace_rpc: Optional[bool] = None
+
     # --- server-tier profiling (reference docs/timeline.md:1-30,
     # BYTEPS_SERVER_ENABLE_PROFILE) ---------------------------------------
     server_enable_profile: bool = False
@@ -189,6 +201,9 @@ class Config:
             log_level=_env_str("BYTEPS_LOG_LEVEL", "WARNING"),
             debug_sample_tensor=_env_str("BYTEPS_DEBUG_SAMPLE_TENSOR", ""),
             trace_path=_env_str("BYTEPS_TRACE_PATH", ""),
+            metrics_port=_env_int("BYTEPS_METRICS_PORT", 0),
+            trace_buffer=_env_int("BYTEPS_TRACE_BUFFER", 100_000),
+            trace_rpc=_env_opt_bool("BYTEPS_TRACE_RPC"),
             server_enable_profile=_env_bool("BYTEPS_SERVER_ENABLE_PROFILE"),
             server_profile_output_path=_env_str(
                 "BYTEPS_SERVER_PROFILE_OUTPUT_PATH", "server_profile.json"),

@@ -10,7 +10,10 @@ monotonic counters, per tensor and in total:
 With ``BYTEPS_TRACE_PATH`` set they land on the shared chrome-trace
 timeline as counter tracks (one global track each, plus a per-tensor
 instant event carrying the tensor name), so wire savings render next to
-the push/pull spans in Perfetto.  ``log_summary()`` — called from
+the push/pull spans in Perfetto.  Since PR 6 the totals also live in
+the shared metrics registry (``observability/metrics.py`` — the global
+one for ``get_compression_stats()``), so ``/metrics`` and ``OP_STATS``
+scrapes see wire savings live.  ``log_summary()`` — called from
 ``RemoteStore.close()`` — emits the run-end one-liner.
 """
 
@@ -20,6 +23,7 @@ import threading
 from typing import Dict, Optional, Tuple
 
 from ..common import logging as bps_log
+from ..observability.metrics import MetricsRegistry, get_registry
 
 WIRE_BYTES_SENT = "compression.wire_bytes_sent"
 WIRE_BYTES_SAVED = "compression.wire_bytes_saved"
@@ -28,12 +32,21 @@ WIRE_BYTES_SAVED = "compression.wire_bytes_saved"
 class CompressionStats:
     """Thread-safe per-tensor wire byte accounting with Tracer surfacing."""
 
-    def __init__(self, tracer=None):
+    def __init__(self, tracer=None,
+                 registry: Optional[MetricsRegistry] = None):
         self._lock = threading.Lock()
         self._per_tensor: Dict[str, Tuple[int, int]] = {}  # name -> (raw, wire)
         self._raw_total = 0
         self._wire_total = 0
         self._tracer = tracer
+        self._registry = (registry if registry is not None
+                          else MetricsRegistry(tracer=tracer))
+        # per-frame bumps: counter value track only, no instant spam
+        # (the per-tensor instant below carries the detail)
+        self._c_sent = self._registry.counter(
+            WIRE_BYTES_SENT, track="compression", instants=False)
+        self._c_saved = self._registry.counter(
+            WIRE_BYTES_SAVED, track="compression", instants=False)
 
     def _get_tracer(self):
         if self._tracer is not None:
@@ -42,17 +55,22 @@ class CompressionStats:
 
         return get_tracer()
 
+    @property
+    def registry(self) -> MetricsRegistry:
+        return self._registry
+
     def observe(self, name: str, raw_bytes: int, wire_bytes: int) -> None:
         with self._lock:
             r, w = self._per_tensor.get(name, (0, 0))
             self._per_tensor[name] = (r + raw_bytes, w + wire_bytes)
             self._raw_total += raw_bytes
             self._wire_total += wire_bytes
-            sent, saved = self._wire_total, self._raw_total - self._wire_total
+        # registry counters mirror the totals onto the Tracer value
+        # tracks (same series the pre-registry code emitted by hand)
+        self._c_sent.inc(wire_bytes)
+        self._c_saved.inc(raw_bytes - wire_bytes)
         tracer = self._get_tracer()
         if tracer.enabled:
-            tracer.counter(WIRE_BYTES_SENT, sent, "compression")
-            tracer.counter(WIRE_BYTES_SAVED, saved, "compression")
             tracer.instant(WIRE_BYTES_SENT, "compression", tensor=name,
                            raw=raw_bytes, wire=wire_bytes)
 
@@ -97,11 +115,17 @@ def get_compression_stats() -> CompressionStats:
     global _stats
     with _stats_lock:
         if _stats is None:
-            _stats = CompressionStats()
+            _stats = CompressionStats(registry=get_registry())
         return _stats
 
 
 def reset_compression_stats() -> None:
+    """Forget the singleton AND its counts: the ``compression.*``
+    metrics live in the process-global registry, which outlives the
+    singleton, so they are removed explicitly — otherwise a rebuilt
+    ``get_compression_stats()`` would report pre-reset byte totals."""
     global _stats
     with _stats_lock:
-        _stats = None
+        inst, _stats = _stats, None
+    if inst is not None:
+        inst.registry.remove_prefix("compression.")

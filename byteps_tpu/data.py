@@ -74,8 +74,10 @@ class NativeLoader:
       normalize: optional ``(scale, bias)`` — emits
         ``float32 x*scale + bias``; None emits raw uint8.
       shuffle: per-epoch reshuffle (seeded).
-      num_threads / depth: prefetch workers / ring slots.  With
-        ``num_threads=1`` batch order is exactly the seeded permutation.
+      num_threads / depth: prefetch workers / ring slots.  Batches are
+        delivered in claim order regardless of thread count, so the
+        stream is always exactly the seeded permutation (workers only
+        parallelize the gather/cast, never reorder output).
       copy: yield copies (safe to hold across iterations).  ``False``
         yields zero-copy ring views valid only until the next ``next()``
         — the fast path for immediate ``jax.device_put``.

@@ -69,28 +69,31 @@ restores the serial one-frame-in-flight client (the A/B baseline).
 
 from __future__ import annotations
 
+import json
 import socket
 import socketserver
 import struct
 import threading
 import time
+from contextlib import contextmanager
 from typing import List, Optional
 
 import numpy as np
 
 from ..common import logging as bps_log
 from ..common.context import name_key
+from ..common.tracing import get_tracer
 from ..compression.wire import WireBlob  # noqa: F401  (re-export compat)
 from .async_ps import AsyncParameterServer
 # framing codec + pipeline live in engine/wire.py; re-exported here
 # because the chaos proxy, the serving frontend and tests import them
 # from this module (one wire framing, one reader)
-from .wire import (ShardWorker, _decode, _dtype_to_wire,  # noqa: F401
-                   _encode, _encode_buffers, _recv_exact, _send_buffers,
-                   _wire_to_dtype, hard_reset)
+from .wire import (ShardWorker, _decode, _decode_frame,  # noqa: F401
+                   _dtype_to_wire, _encode, _encode_buffers, _recv_exact,
+                   _send_buffers, _wire_to_dtype, hard_reset)
 
 (OP_INIT, OP_PUSH_PULL, OP_PULL, OP_VERSION, OP_NAMES, OP_PING, OP_PUSH,
- OP_SET) = range(8)
+ OP_SET, OP_STATS) = range(9)
 
 
 # -------------------------------------------------------------------- server
@@ -130,7 +133,7 @@ class ServerProfiler:
         self._epoch = time.time() - time.perf_counter()
 
     def record(self, op: int, name: str, peer: str, t_begin: float,
-               t_end: float) -> None:
+               t_end: float, trace_id: str = "") -> None:
         opname = _PROFILED_OPS.get(op)
         if opname is None:
             return
@@ -138,8 +141,14 @@ class ServerProfiler:
         if self._key_filter is not None and key != self._key_filter:
             return
         ev = f"{opname}-{peer}"
+        # the trace id (wire header extension, docs/observability.md) is
+        # the join key trace_merge correlates this server span with the
+        # issuing client's client-queue/wire spans on
+        args = {"tensor": name}
+        if trace_id:
+            args["trace_id"] = trace_id
         b = {"name": ev, "ph": "B", "pid": key, "tid": key,
-             "ts": int((self._epoch + t_begin) * 1e6)}
+             "ts": int((self._epoch + t_begin) * 1e6), "args": args}
         e = {"name": ev, "ph": "E", "pid": key, "tid": key,
              "ts": int((self._epoch + t_end) * 1e6)}
         drained = None
@@ -247,13 +256,27 @@ class _Handler(socketserver.BaseRequestHandler):
         sock = self.request
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.server.track_connection(sock)  # type: ignore[attr-defined]
+        # live request accounting (process registry — what OP_STATS and
+        # /metrics serve); metric objects resolved once per connection
+        from ..observability.metrics import get_registry
+
+        _reg = get_registry()
+        # registry-only (mirror=False): per-request trace detail is the
+        # profiler's job; a counter event per request would tax the
+        # handler loop for a redundant series (bench_obs.py)
+        m_reqs = _reg.counter("ps.requests", track="ps_server",
+                              instants=False, mirror=False)
+        m_errs = _reg.counter("ps.request_errors", track="ps_server",
+                              instants=False, mirror=False)
+        m_handle = _reg.histogram("ps.handle_s", track="ps_server")
         try:
             while True:
                 try:
-                    op, name, arr, _ = _decode(sock)
+                    op, name, arr, _, tid = _decode_frame(sock)
                 except ConnectionError:
                     return
                 t_begin = time.perf_counter()
+                failed = False
                 # store-level errors (e.g. pull of an un-init'd name) reply
                 # status=1 and keep the connection alive — only wire-level
                 # failures tear it down
@@ -308,16 +331,35 @@ class _Handler(socketserver.BaseRequestHandler):
                         reply = _encode_buffers(0, "", None,
                                         "\n".join(store.names()).encode())
                     elif op == OP_PING:
-                        reply = _encode_buffers(0, "", None)
+                        # the reply carries this host's wall clock so
+                        # clients can estimate per-shard clock offsets
+                        # NTP-style (observability/trace.py); pre-PR-6
+                        # clients ignore the payload
+                        reply = _encode_buffers(0, "", None,
+                                                struct.pack("<d", time.time()))
+                    elif op == OP_STATS:
+                        # live stats scrape over the existing binary
+                        # protocol — the in-band twin of the HTTP
+                        # /metrics endpoint (docs/observability.md)
+                        payload = json.dumps(
+                            self.server.stats_payload())  # type: ignore[attr-defined]
+                        reply = _encode_buffers(0, "", None, payload.encode())
                     else:
                         reply = _encode_buffers(1, "", None, f"bad op {op}".encode())
                 except Exception as e:
+                    failed = True
                     reply = _encode_buffers(
                         1, "", None, f"{type(e).__name__}: {e}".encode()
                     )
+                t_end = time.perf_counter()
+                m_reqs.inc()
+                if failed:
+                    m_errs.inc()
+                if op in _PROFILED_OPS:
+                    m_handle.observe(t_end - t_begin)
                 if profiler is not None:
-                    profiler.record(op, name, peer, t_begin,
-                                    time.perf_counter())
+                    profiler.record(op, name, peer, t_begin, t_end,
+                                    trace_id=tid.hex() if tid else "")
                 _send_buffers(sock, reply)
         except Exception as e:  # pragma: no cover - connection teardown races
             bps_log.debug("ps_server handler exit: %s", e)
@@ -337,6 +379,7 @@ class PSServer(socketserver.ThreadingTCPServer):
         # for the rest of its budget
         try:
             self.profiler: Optional[ServerProfiler] = None
+            self._t0 = time.monotonic()
             self.store = AsyncParameterServer(use_native=use_native)
             # live client connections, so kill() can sever them the way a
             # dying process would (shutdown() alone only stops the accept
@@ -363,6 +406,19 @@ class PSServer(socketserver.ThreadingTCPServer):
         except Exception:
             super().server_close()
             raise
+
+    def stats_payload(self) -> dict:
+        """The ``OP_STATS`` reply body: shard identity + the process
+        metrics-registry snapshot (same bytes ``/metrics.json``
+        serves)."""
+        from ..observability.metrics import get_registry
+
+        return {
+            "role": "ps_server",
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+            "tensors": len(self.store.names()),
+            "metrics": get_registry().snapshot(),
+        }
 
     def track_connection(self, sock) -> None:
         with self._conns_lock:
@@ -398,6 +454,13 @@ def serve(port: int, host: str = "0.0.0.0", use_native: bool = True,
     srv = PSServer((host, port), use_native=use_native)
     bps_log.info("byteps_tpu PS server shard listening on %s:%d",
                  host, srv.server_address[1])
+    # live scrape endpoint (BYTEPS_METRICS_PORT; off by default) — the
+    # HTTP twin of OP_STATS for operators without a wire client handy
+    from ..observability.scrape import maybe_start_metrics_server
+
+    maybe_start_metrics_server(
+        role="ps_server",
+        health_fn=lambda: {"tensors": len(srv.store.names())})
     if in_thread:
         t = threading.Thread(target=srv.serve_forever, daemon=True)
         t.start()
@@ -521,6 +584,13 @@ class RemoteStore:
             policy = CompressionPolicy.from_config(cfg)
         self._wire_stats = get_compression_stats()
         self._compressor = WireCompressor(policy, stats=self._wire_stats)
+        # distributed per-RPC tracing (docs/observability.md): when on,
+        # public ops mint an 8-byte trace id, every frame of the op
+        # carries it in the wire-header extension, and the client emits
+        # client-queue/wire spans stamped with it
+        from ..observability.trace import rpc_tracing_enabled
+
+        self._trace_rpc = rpc_tracing_enabled(cfg)
         self._partition_bytes = cfg.effective_partition_bytes
         self._part_meta: dict = {}  # base name -> (nparts, shape, dtype)
         # failover/restart seed cache (_last_global).  Off when the user
@@ -580,6 +650,54 @@ class RemoteStore:
             self._counters.bump(self._cn.WINDOW_ABORT, shard=shard,
                                 n=1, inflight=n_inflight)
 
+    # ------------------------------------------------- distributed tracing
+
+    def _tid(self) -> bytes:
+        """The trace id every frame of the current op carries (b"" when
+        RPC tracing is off or no op context is active)."""
+        if not self._trace_rpc:
+            return b""
+        from ..observability.trace import current_trace_id
+
+        return current_trace_id()
+
+    @contextmanager
+    def _traced(self, opname: str, name: str):
+        """Per-op trace scope: mint (or join) a trace id for the
+        calling thread and wrap the op in a ``client`` span carrying
+        it.  No-op when RPC tracing is off — the hot path pays one
+        attribute check."""
+        if not self._trace_rpc:
+            yield b""
+            return
+        from ..observability.trace import trace_context
+
+        with trace_context() as tid:
+            tracer = get_tracer()
+            if tracer.enabled:
+                with tracer.span(f"{opname}:{name}", "client",
+                                 trace_id=tid.hex()):
+                    yield tid
+            else:
+                yield tid
+
+    def _trace_part_spans(self, name: str, pending) -> None:
+        """Emit the client-queue (submit->sent) and wire (sent->reply)
+        spans of one acked frame from the stamps its ``PendingRpc``
+        noted — the I/O threads never touch the tracer."""
+        if not self._trace_rpc:
+            return
+        tracer = get_tracer()
+        if not tracer.enabled or not pending.t_sent:
+            return
+        tid = self._tid().hex()
+        tracer.complete(name or "<frame>", "client-queue",
+                        pending.t_submit, pending.t_sent - pending.t_submit,
+                        trace_id=tid)
+        if pending.t_reply:
+            tracer.complete(name or "<frame>", "wire", pending.t_sent,
+                            pending.t_reply - pending.t_sent, trace_id=tid)
+
     # -------------------------------------------------- part-level fan-out
 
     def _submit_part(self, shard: int, op: int, name: str, arr=None,
@@ -596,8 +714,8 @@ class RemoteStore:
             return None
         try:
             return self._workers[shard].submit(
-                _encode_buffers(op, name, arr, raw), priority=priority,
-                key=key)
+                _encode_buffers(op, name, arr, raw, trace_id=self._tid()),
+                priority=priority, key=key)
         except ConnectionError:
             return None
 
@@ -796,19 +914,37 @@ class RemoteStore:
         if self._workers is not None:
             worker = self._workers[shard]
             if pending is None:
-                pending = worker.submit(_encode_buffers(op, name, arr, raw),
-                                        priority=priority, key=key)
+                pending = worker.submit(
+                    _encode_buffers(op, name, arr, raw,
+                                    trace_id=self._tid()),
+                    priority=priority, key=key)
             status, rname, out, payload = worker.wait(pending, wait)
+            self._trace_part_spans(name, pending)
         else:
+            t0 = 0.0
             with self._locks[shard]:
+                # stamp INSIDE the lock: waiting for another thread's
+                # RPC on this shard is client-side queueing, not wire
+                # time — the exact confusion the straggler workflow
+                # exists to resolve
+                if self._trace_rpc:
+                    t0 = time.perf_counter()
                 try:
                     sock = self._sock(shard)
                     sock.settimeout(wait)
-                    _send_buffers(sock, _encode_buffers(op, name, arr, raw))
+                    _send_buffers(sock,
+                                  _encode_buffers(op, name, arr, raw,
+                                                  trace_id=self._tid()))
                     status, rname, out, payload = _decode(sock)
                 except _WIRE_ERRORS:
                     self._drop_socket_locked(shard)
                     raise
+            if self._trace_rpc:
+                tracer = get_tracer()
+                if tracer.enabled:
+                    tracer.complete(name or "<frame>", "wire", t0,
+                                    time.perf_counter() - t0,
+                                    trace_id=self._tid().hex())
         if status != 0:
             raise RuntimeError(f"ps_server error: {bytes(payload).decode()!r}")
         return rname, out, payload
@@ -1208,17 +1344,23 @@ class RemoteStore:
         # must not start life quantized
         prio = self._priority_of(name)
         parts = self._partition(name, np.asarray(value))
-        self._pipeline_parts(OP_INIT, parts, self._encode_raw, prio)
+        with self._traced("init", name):
+            self._pipeline_parts(OP_INIT, parts, self._encode_raw, prio)
 
     def push_delta(self, name: str, delta: np.ndarray,
                    priority: Optional[int] = None) -> None:
         # OP_PUSH replies status-only: no pointless global-tensor download
         prio = self._priority_of(name) if priority is None else priority
         parts = self._partition(name, np.asarray(delta))
-        self._pipeline_parts(OP_PUSH, parts,
-                             self._compressor.encode_mutation, prio)
+        with self._traced("push", name):
+            self._pipeline_parts(OP_PUSH, parts,
+                                 self._compressor.encode_mutation, prio)
 
     def pull(self, name: str) -> np.ndarray:
+        with self._traced("pull", name):
+            return self._pull_traced(name)
+
+    def _pull_traced(self, name: str) -> np.ndarray:
         prio = self._priority_of(name)
         meta = self._part_names(name)
         if meta is None:
@@ -1247,10 +1389,11 @@ class RemoteStore:
         d = np.asarray(delta)
         prio = self._priority_of(name) if priority is None else priority
         parts = self._partition(name, d)
-        outs = [np.asarray(o).reshape(-1) for o in
-                self._pipeline_parts(OP_PUSH_PULL, parts,
-                                     self._compressor.encode_mutation,
-                                     prio)]
+        with self._traced("push_pull", name):
+            outs = [np.asarray(o).reshape(-1) for o in
+                    self._pipeline_parts(OP_PUSH_PULL, parts,
+                                         self._compressor.encode_mutation,
+                                         prio)]
         if len(outs) == 1:
             return np.array(outs[0]).reshape(d.shape)
         return self._assemble_flat(outs, outs[0].dtype).reshape(d.shape)
@@ -1299,6 +1442,38 @@ class RemoteStore:
     def health(self) -> List[bool]:
         """Per-shard routing health (True = primary placement active)."""
         return [not self._router.is_down(i) for i in range(len(self._addrs))]
+
+    def shard_stats(self, shard: int) -> dict:
+        """Live ``OP_STATS`` scrape of one shard: its identity plus the
+        shard process's metrics-registry snapshot — the in-band twin of
+        the shard's HTTP ``/metrics.json`` (docs/observability.md)."""
+        _, payload = self._rpc(shard, OP_STATS, "")
+        return json.loads(bytes(payload).decode())
+
+    def record_clock_offsets(self, samples: int = 5) -> List:
+        """Estimate every shard's wall-clock offset (NTP-style midpoint
+        over ``OP_PING`` — observability/trace.py) and drop each
+        estimate into the client trace as a ``clock_offset`` instant
+        event.  That event is the in-band channel
+        ``scripts/trace_merge.py`` reads per-host offsets from, so a
+        merge needs no side-file.  Unreachable shards are skipped with
+        a warning (their spans stay unaligned rather than failing the
+        run).  Returns the :class:`ClockOffset` list."""
+        from ..observability.trace import estimate_clock_offset
+
+        tracer = get_tracer()
+        out = []
+        for addr in self._addrs:
+            try:
+                off = estimate_clock_offset(addr, n=samples)
+            except (ConnectionError, OSError) as e:
+                bps_log.warning("clock offset for %s unavailable: %s",
+                                addr, e)
+                continue
+            out.append(off)
+            if tracer.enabled:
+                tracer.instant("clock_offset", "client", **off.as_dict())
+        return out
 
     def close(self) -> None:
         if self._detector is not None:

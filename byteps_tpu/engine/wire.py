@@ -52,6 +52,7 @@ import collections
 import socket
 import struct
 import threading
+import time
 from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -63,6 +64,24 @@ from ..compression.wire import WIRE_MAGIC, WireBlob, decode_blob
 
 _MAX_NAME = 1 << 16
 _MAX_PAYLOAD = 1 << 34  # 16 GiB sanity bound
+
+# versioned header extension (distributed tracing, docs/observability.md):
+# a frame whose op byte has _EXT_FLAG set carries, between the fixed
+# 5-byte head and the name, an extension block
+#     u8 version | u8 length | <length bytes>
+# Version 1's body is the 8-byte per-RPC trace id minted at
+# push_pull/serving submit.  Forward compatibility is LOUD like the
+# compression tag ``bpsc1``: this decoder raises on an unknown
+# extension version rather than guessing at its length's meaning.
+# Backward is NOT protected — a pre-extension server reads the
+# extension bytes as the start of the name and desyncs on the shifted
+# length fields (hang/garbage, not a clean "bad op"), because its
+# decoder consumes the whole frame before dispatching on op.  Set
+# ``BYTEPS_TRACE_RPC=0`` on the client when talking to older shards
+# (the auto default only extends frames when tracing is on).
+_EXT_FLAG = 0x80
+_EXT_VERSION = 1
+_TRACE_ID_LEN = 8
 
 
 # ---------------------------------------------------------------- wire codec
@@ -126,11 +145,14 @@ def _payload_view(arr: np.ndarray):
     return arr.reshape(-1).view(np.uint8)
 
 
-def _encode_buffers(op: int, name: str, arr, raw: bytes = b"") -> List:
+def _encode_buffers(op: int, name: str, arr, raw: bytes = b"",
+                    trace_id: bytes = b"") -> List:
     """Build one request/reply frame as a buffer LIST for scatter-gather
     send: ``[header, payload...]`` with the payload a zero-copy view of
     the tensor (or the WireBlob's own buffers).  ``b"".join`` of the
-    result is byte-identical to the seed's single-buffer framing."""
+    result is byte-identical to the seed's single-buffer framing.
+    A non-empty ``trace_id`` (8 bytes) rides the versioned header
+    extension — see the module-level framing notes."""
     nb = name.encode()
     payload_bufs: Sequence
     if isinstance(arr, WireBlob):
@@ -154,7 +176,16 @@ def _encode_buffers(op: int, name: str, arr, raw: bytes = b"") -> List:
         shape = ()
         payload_bufs = (raw,) if raw else ()
         plen = len(raw)
-    head = struct.pack("<BI", op, len(nb)) + nb
+    if trace_id:
+        if len(trace_id) != _TRACE_ID_LEN:
+            raise ValueError(
+                f"trace id must be {_TRACE_ID_LEN} bytes, got "
+                f"{len(trace_id)}")
+        head = struct.pack("<BI", op | _EXT_FLAG, len(nb))
+        head += struct.pack("<BB", _EXT_VERSION, _TRACE_ID_LEN) + trace_id
+        head += nb
+    else:
+        head = struct.pack("<BI", op, len(nb)) + nb
     head += struct.pack("<I", len(dt)) + dt
     head += struct.pack("<B", len(shape)) + struct.pack(
         f"<{len(shape)}Q", *shape
@@ -185,10 +216,23 @@ def _send_buffers(sock: socket.socket, buffers: Sequence) -> None:
             views[0] = views[0][sent:]
 
 
-def _decode(sock: socket.socket):
+def _decode_frame(sock: socket.socket):
+    """Read one frame: ``(op, name, arr, payload, trace_id)``.  The
+    trace id is b"" for unextended frames; an unknown extension version
+    raises (loud, never a silent misread — the ``bpsc1`` discipline)."""
     op, nlen = struct.unpack("<BI", _recv_exact(sock, 5))
     if nlen > _MAX_NAME:
         raise ValueError(f"name too long: {nlen}")
+    trace_id = b""
+    if op & _EXT_FLAG:
+        ver, elen = struct.unpack("<BB", _recv_exact(sock, 2))
+        ext = bytes(_recv_exact(sock, elen))
+        if ver != _EXT_VERSION:
+            raise ValueError(
+                f"unknown wire header extension version {ver} "
+                f"(peer newer than this build?)")
+        trace_id = ext[:_TRACE_ID_LEN]
+        op &= ~_EXT_FLAG
     name = _recv_exact(sock, nlen).decode()
     (dlen,) = struct.unpack("<I", _recv_exact(sock, 4))
     dt = _recv_exact(sock, dlen).decode()
@@ -208,6 +252,13 @@ def _decode(sock: socket.socket):
         else:
             arr = np.frombuffer(payload,
                                 dtype=_wire_to_dtype(dt)).reshape(shape)
+    return op, name, arr, payload, trace_id
+
+
+def _decode(sock: socket.socket):
+    """Legacy 4-tuple read (trace id dropped) — the shape every
+    pre-extension call site expects."""
+    op, name, arr, payload, _ = _decode_frame(sock)
     return op, name, arr, payload
 
 
@@ -217,14 +268,22 @@ def _decode(sock: socket.socket):
 class PendingRpc:
     """One submitted request: its frame buffers and the future its
     caller blocks on.  Settling (resolve/fail) is idempotent — kill
-    paths and late receivers may race, first one wins."""
+    paths and late receivers may race, first one wins.
+
+    The three ``perf_counter`` stamps (submit/sent/reply) are the raw
+    material for the client-queue and wire trace spans the store emits
+    after ``wait`` (docs/observability.md) — noting times here keeps
+    the I/O threads off the tracer entirely.  ``stamp=False`` (RPC
+    tracing off) skips all three clock reads: they would only ever be
+    read by ``_trace_part_spans``, which no-ops without a tracer."""
 
     __slots__ = ("buffers", "state", "done", "event", "error",
-                 "status", "rname", "out", "payload", "_plock")
+                 "status", "rname", "out", "payload", "_plock",
+                 "t_submit", "t_sent", "t_reply")
 
     QUEUED, SENT = 0, 1
 
-    def __init__(self, buffers: List):
+    def __init__(self, buffers: List, stamp: bool = False):
         self.buffers = buffers
         self.state = PendingRpc.QUEUED  # wire bookkeeping (worker lock)
         self.done = False               # settled flag (own lock)
@@ -232,6 +291,9 @@ class PendingRpc:
         self.error: Optional[BaseException] = None
         self.status = self.rname = self.out = self.payload = None
         self._plock = threading.Lock()
+        self.t_submit = time.perf_counter() if stamp else 0.0
+        self.t_sent = 0.0
+        self.t_reply = 0.0
 
     def _settle(self) -> bool:
         with self._plock:
@@ -242,6 +304,8 @@ class PendingRpc:
 
     def resolve(self, status, rname, out, payload) -> None:
         if self._settle():
+            if self.t_submit:
+                self.t_reply = time.perf_counter()
             self.status, self.rname = status, rname
             self.out, self.payload = out, payload
             self.buffers = None  # free the request frame early
@@ -294,6 +358,46 @@ class ShardWorker:
         self._gen = 0  # connection generation; bumped on every kill
         self._closed = threading.Event()
         self._sender: Optional[threading.Thread] = None
+        from ..observability.metrics import get_registry
+
+        reg = get_registry()
+        # live wire metrics (observability/metrics.py, global registry):
+        # resolved once here — the send/recv loops must not pay a
+        # registry lookup per frame.  All registry-only (mirror=False)
+        # except window occupancy: these fire several times per frame on
+        # the I/O threads, per-frame trace detail already comes from the
+        # client-queue/wire spans, and mirroring every bump measurably
+        # taxes the step (bench_obs.py) — scrapes still see live values
+        self._m_bytes = reg.counter("wire.bytes_sent", track="wire",
+                                    instants=False, mirror=False,
+                                    shard=shard)
+        self._m_frames = reg.counter("wire.frames_sent", track="wire",
+                                     instants=False, mirror=False,
+                                     shard=shard)
+        self._m_replies = reg.counter("wire.replies_received", track="wire",
+                                      instants=False, mirror=False,
+                                      shard=shard)
+        self._m_inflight = reg.gauge("wire.inflight", track="wire",
+                                     mirror=False, shard=shard)
+        self._m_qdepth = reg.gauge("wire.queue_depth", track="wire",
+                                   mirror=False, shard=shard)
+        # window occupancy: in-flight / window, the live "is the wire
+        # full" signal — the one wire series that stays on the chrome
+        # trace (scripts/trace_report.py's window-stall histogram)
+        self._m_occ = reg.gauge("wire.window_occupancy", track="wire",
+                                shard=shard)
+        # resolved once: whether frames get perf_counter stamps (three
+        # clock reads per frame otherwise wasted — only
+        # _trace_part_spans ever reads them, and it no-ops untraced)
+        from ..observability.trace import rpc_tracing_enabled
+
+        self._stamp = rpc_tracing_enabled()
+
+    def _note_inflight_locked(self) -> None:
+        """Caller holds ``_lock``; publishes the window state gauges."""
+        used = self._window - self._free
+        self._m_inflight.set(used)
+        self._m_occ.set(used / self._window)
 
     # --------------------------------------------------------------- submit
 
@@ -306,11 +410,12 @@ class ShardWorker:
         stay queued until replies free slots."""
         if self._closed.is_set():
             raise ConnectionError(f"shard {self._shard} wire worker closed")
-        pending = PendingRpc(buffers)
+        pending = PendingRpc(buffers, stamp=self._stamp)
         task = TensorTaskEntry(name="", key=key, priority=priority,
                                payload=pending)
         self._ensure_sender()
         self._queue.add_task(task)
+        self._m_qdepth.set(self._queue.pending())
         return pending
 
     def wait(self, pending: PendingRpc, timeout: Optional[float]):
@@ -391,12 +496,20 @@ class ShardWorker:
                 if pending.done or bufs is None:
                     continue  # aborted between dequeue and here
                 pending.state = PendingRpc.SENT
+                if pending.t_submit:
+                    pending.t_sent = time.perf_counter()
                 self._inflight.append(pending)
                 self._free -= 1
+                self._note_inflight_locked()
+            nbytes = sum(len(b) for b in bufs)
             try:
                 _send_buffers(sock, bufs)
             except OSError as e:
                 self._kill(gen, e)  # drains in-flight (incl. this frame)
+            else:
+                self._m_bytes.inc(nbytes)
+                self._m_frames.inc()
+                self._m_qdepth.set(self._queue.pending())
         # worker closing: everything still queued fails loudly
         for task in self._queue.drain():
             task.payload.fail(ConnectionError("wire worker closed"))
@@ -449,7 +562,9 @@ class ShardWorker:
                     break  # reply with no request: protocol violation
                 pending = self._inflight.popleft()
                 self._free += 1
+                self._note_inflight_locked()
                 self._cv.notify()  # wake a window-gated sender
+            self._m_replies.inc()
             pending.resolve(status, rname, out, payload)
         self._kill(gen, ValueError(
             f"shard {self._shard}: reply with no request in flight"))
@@ -467,6 +582,7 @@ class ShardWorker:
             victims = list(self._inflight)
             self._inflight.clear()
             self._free += len(victims)
+            self._note_inflight_locked()
             self._cv.notify()
         if sock is not None:
             # shutdown() BEFORE close(): closing an fd another thread is

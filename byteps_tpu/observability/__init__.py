@@ -1,0 +1,33 @@
+"""Unified observability layer — metrics registry, live scrape
+endpoints, and cross-process RPC trace correlation.
+
+Three pieces (see docs/observability.md):
+
+  * :mod:`.metrics` — the typed Counter/Gauge/Histogram registry every
+    subsystem's stats now land in (resilience, serving, compression,
+    the wire engine), with Prometheus-text and JSON exposition.
+  * :mod:`.scrape` — the live surface: ``/metrics`` + ``/healthz`` over
+    stdlib HTTP (``BYTEPS_METRICS_PORT``); the PS wire ``OP_STATS`` op
+    and the serving TCP STATS reply serve the same snapshot in-band.
+  * :mod:`.trace` / :mod:`.export` — per-RPC trace ids carried in the
+    wire frame, clock-offset estimation over OP_PING, and the merge
+    tooling (``scripts/trace_merge.py``) that aligns client and server
+    trace files into one Perfetto timeline.
+"""
+
+from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
+                      MetricsRegistry, get_registry, reset_registry)
+from .scrape import (MetricsServer, maybe_start_metrics_server,  # noqa: F401
+                     start_metrics_server, stop_metrics_server)
+from .trace import (ClockOffset, current_trace_id,  # noqa: F401
+                    estimate_clock_offset, mint_trace_id,
+                    rpc_tracing_enabled, trace_context, trace_id_hex)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "get_registry", "reset_registry",
+    "MetricsServer", "start_metrics_server", "maybe_start_metrics_server",
+    "stop_metrics_server",
+    "ClockOffset", "current_trace_id", "estimate_clock_offset",
+    "mint_trace_id", "rpc_tracing_enabled", "trace_context", "trace_id_hex",
+]

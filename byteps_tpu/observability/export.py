@@ -1,0 +1,166 @@
+"""Trace export/merge helpers — one loader for both trace dialects.
+
+The repo emits chrome-trace files in two shapes: the client ``Tracer``
+writes the object form (``{"traceEvents": [...]}``) and the PS-tier
+``ServerProfiler`` appends a bare JSON array (crash-tolerant: the
+viewer's documented leniency about a missing ``]``).  Both stamp
+**wall-clock-anchored** microsecond timestamps since this PR (a
+``time.time()`` epoch mapped onto ``perf_counter`` monotonic deltas),
+so events from different processes live on comparable axes once
+per-host clock offsets (``observability/trace.py``) are subtracted.
+
+:func:`merge_traces` is the library behind ``scripts/trace_merge.py``:
+load N files, shift each by its host's offset, tag events with a
+process name, and (optionally) regroup every event that carries a
+``trace_id`` arg onto one row per id — the view where a single
+push_pull's client-queue/wire/server spans nest under one another.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["load_trace_events", "clock_offsets_from_events",
+           "merge_traces", "span_durations"]
+
+
+def load_trace_events(path: str) -> List[dict]:
+    """Events from either trace dialect; tolerates the profiler's
+    unterminated mid-run array (strips trailing separators and closes
+    it) — post-mortem tooling must read the file a crash left behind."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        # unterminated array: drop a trailing comma/whitespace, close it
+        repaired = text.rstrip().rstrip(",")
+        if repaired.startswith("["):
+            doc = json.loads(repaired + "\n]")
+        elif repaired.startswith("{"):
+            doc = json.loads(repaired + "\n]}")
+        else:
+            raise
+    if isinstance(doc, dict):
+        return list(doc.get("traceEvents", []))
+    return list(doc)
+
+
+def clock_offsets_from_events(events: Sequence[dict]) -> Dict[str, float]:
+    """``addr -> offset_us`` from the ``clock_offset`` instant events a
+    client records after :meth:`RemoteStore.record_clock_offsets` — the
+    in-band channel that spares the merge CLI an offsets side-file.
+    The last estimate per address wins (latest = closest to the spans
+    it corrects)."""
+    out: Dict[str, float] = {}
+    for ev in events:
+        if ev.get("name") == "clock_offset" and ev.get("ph") == "i":
+            args = ev.get("args", {})
+            addr = args.get("addr")
+            if addr is not None and "offset_us" in args:
+                out[str(addr)] = float(args["offset_us"])
+    return out
+
+
+def _process_name_event(pid, name: str) -> dict:
+    return {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": name}}
+
+
+def merge_traces(sources: Sequence[Tuple[str, List[dict], float]],
+                 by_trace: bool = False) -> dict:
+    """Merge ``(label, events, offset_us)`` sources into one loadable
+    object-form trace.
+
+    Each source's events are shifted by ``-offset_us`` (mapping its
+    host clock onto the reference host's — pass 0 for the reference,
+    usually the client) and pid-tagged per source so Perfetto shows one
+    named track group per process.  ``by_trace=True`` additionally
+    emits a copy of every event carrying ``args.trace_id`` onto a
+    synthetic per-trace-id row — the "follow one push_pull end to end"
+    view the straggler FAQ points at."""
+    merged: List[dict] = []
+    for i, (label, events, offset_us) in enumerate(sources):
+        pid = 1000 + i
+        merged.append(_process_name_event(pid, label))
+        for ev in events:
+            if ev.get("ph") == "M":
+                continue  # per-source metadata replaced by ours
+            ev = dict(ev)
+            if "ts" in ev:
+                ev["ts"] = float(ev["ts"]) - offset_us
+            ev["pid"] = pid
+            merged.append(ev)
+    if by_trace:
+        # complete spans and instants copy straight over; profiler B/E
+        # pairs are CONVERTED to X spans here — the E event carries no
+        # trace id, so copying raw B events would leave unterminated
+        # "did not finish" spans stretching across the whole by-trace
+        # row in Perfetto
+        tid_pid = 9999
+        merged.append(_process_name_event(tid_pid, "by-trace-id"))
+        extra: List[dict] = []
+        open_b: Dict[Tuple, List[dict]] = {}
+        for ev in merged:
+            ph = ev.get("ph")
+            if ph == "M":
+                continue
+            if ph in ("X", "i"):
+                tid = ev.get("args", {}).get("trace_id")
+                if tid:
+                    c = dict(ev)
+                    c["pid"] = tid_pid
+                    c["tid"] = str(tid)
+                    extra.append(c)
+            elif ph == "B":
+                open_b.setdefault((ev.get("pid"), ev.get("tid"),
+                                   ev.get("name")), []).append(ev)
+            elif ph == "E":
+                stack = open_b.get((ev.get("pid"), ev.get("tid"),
+                                    ev.get("name")))
+                if not stack:
+                    continue
+                b = stack.pop()
+                tid = b.get("args", {}).get("trace_id")
+                if tid:
+                    extra.append({
+                        "name": b.get("name"), "cat": b.get("cat", ""),
+                        "ph": "X", "ts": b.get("ts"),
+                        "dur": (float(ev.get("ts", 0.0))
+                                - float(b.get("ts", 0.0))),
+                        "pid": tid_pid, "tid": str(tid),
+                        "args": dict(b.get("args", {}))})
+        merged.extend(extra)
+    return {"traceEvents": merged}
+
+
+def span_durations(events: Sequence[dict]) -> List[Tuple[str, str, float]]:
+    """Flatten spans to ``(name, stage, duration_us)`` rows: complete
+    events directly, B/E pairs (the profiler dialect) matched FIFO per
+    (pid, tid, name).  Events that are not spans are skipped."""
+    rows: List[Tuple[str, str, float]] = []
+    open_b: Dict[Tuple, List[float]] = {}
+    for ev in events:
+        ph = ev.get("ph")
+        if ph == "X":
+            rows.append((str(ev.get("name")), str(ev.get("tid")),
+                         float(ev.get("dur", 0.0))))
+        elif ph == "B":
+            open_b.setdefault(
+                (ev.get("pid"), ev.get("tid"), ev.get("name")),
+                []).append(float(ev.get("ts", 0.0)))
+        elif ph == "E":
+            k = (ev.get("pid"), ev.get("tid"), ev.get("name"))
+            stack = open_b.get(k)
+            if stack:
+                t0 = stack.pop()
+                rows.append((str(ev.get("name")), str(ev.get("tid")),
+                             float(ev.get("ts", 0.0)) - t0))
+    return rows
+
+
+def write_trace(doc: dict, path: str) -> str:
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path

@@ -1,0 +1,161 @@
+"""Live stats surface: stdlib HTTP ``/metrics`` + ``/healthz``.
+
+Every role (worker, PS shard, serving frontend) can expose its
+process-global :class:`~byteps_tpu.observability.metrics.MetricsRegistry`
+over plain HTTP, gated on ``BYTEPS_METRICS_PORT`` (0 = off, the
+default).  Endpoints:
+
+  * ``/metrics``       — Prometheus text exposition (scrape target)
+  * ``/metrics.json``  — the registry ``snapshot()`` as JSON
+  * ``/healthz``       — liveness: ``{"status": "ok", "role": ...,
+    "uptime_s": ...}`` plus whatever the role's ``health_fn`` merges in
+    (the PS server reports tensor count, serving reports occupancy)
+
+Stdlib only (``http.server``), one daemon thread, zero deps — the same
+"cheap, always-on" bar as the rest of the observability layer.  The PS
+tier's ``OP_STATS`` wire op serves the identical snapshot over the
+existing binary protocol for clients already holding a connection.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional, Tuple
+
+from ..common import logging as bps_log
+from .metrics import MetricsRegistry, get_registry
+
+__all__ = ["MetricsServer", "start_metrics_server",
+           "maybe_start_metrics_server", "stop_metrics_server"]
+
+
+class _ScrapeHandler(BaseHTTPRequestHandler):
+    # close each response: curl-style one-shot scrapers are the norm and
+    # keep-alive would pin handler threads per idle scraper
+    protocol_version = "HTTP/1.0"
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler contract
+        srv: "MetricsServer" = self.server  # type: ignore[assignment]
+        try:
+            if self.path.split("?", 1)[0] == "/metrics":
+                self._send(200, srv.registry.to_prometheus().encode(),
+                           "text/plain; version=0.0.4")
+            elif self.path.split("?", 1)[0] == "/metrics.json":
+                self._send(200, srv.registry.to_json().encode(),
+                           "application/json")
+            elif self.path.split("?", 1)[0] == "/healthz":
+                self._send(200, json.dumps(srv.health()).encode(),
+                           "application/json")
+            else:
+                self._send(404, b"not found\n", "text/plain")
+        except Exception as e:  # pragma: no cover - handler must not die
+            try:
+                self._send(500, f"{type(e).__name__}: {e}\n".encode(),
+                           "text/plain")
+            except OSError:
+                pass
+
+    def log_message(self, fmt, *args):  # quiet: scrapes are periodic
+        bps_log.debug("metrics http: " + fmt, *args)
+
+
+class MetricsServer(ThreadingHTTPServer):
+    """The scrape endpoint.  ``health_fn`` (optional) returns a dict
+    merged into the ``/healthz`` body — role-specific liveness detail."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, addr: Tuple[str, int], role: str = "",
+                 registry: Optional[MetricsRegistry] = None,
+                 health_fn: Optional[Callable[[], dict]] = None):
+        super().__init__(addr, _ScrapeHandler)
+        self.registry = registry if registry is not None else get_registry()
+        self.role = role
+        self._health_fn = health_fn
+        self._t0 = time.monotonic()
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+    def health(self) -> dict:
+        out = {"status": "ok", "role": self.role,
+               "uptime_s": round(time.monotonic() - self._t0, 3)}
+        if self._health_fn is not None:
+            try:
+                out.update(self._health_fn())
+            except Exception as e:
+                # a broken detail probe must not flip liveness to a 500
+                out["health_fn_error"] = f"{type(e).__name__}: {e}"
+        return out
+
+
+def start_metrics_server(port: int, host: str = "0.0.0.0", role: str = "",
+                         registry: Optional[MetricsRegistry] = None,
+                         health_fn: Optional[Callable[[], dict]] = None
+                         ) -> MetricsServer:
+    """Bind and serve on a daemon thread; returns the server (its
+    ``.port`` resolves port 0 to the kernel's pick — tests use that)."""
+    srv = MetricsServer((host, port), role=role, registry=registry,
+                        health_fn=health_fn)
+    t = threading.Thread(target=srv.serve_forever,
+                         name="bps-metrics-http", daemon=True)
+    t.start()
+    bps_log.info("metrics endpoint on %s:%d (/metrics /healthz)",
+                 host, srv.port)
+    return srv
+
+
+# one endpoint per process: every role funnels through the same global
+# registry, so a second listener would serve identical bytes
+_server: Optional[MetricsServer] = None
+_server_lock = threading.Lock()
+
+
+def maybe_start_metrics_server(role: str = "",
+                               health_fn: Optional[Callable[[], dict]]
+                               = None) -> Optional[MetricsServer]:
+    """Start the process scrape endpoint iff ``BYTEPS_METRICS_PORT`` is
+    set (>0) and none is running yet.  Idempotent; returns the server
+    (existing or new) or None when the knob is off.  Failures to bind
+    log a warning instead of killing the role — observability must
+    never take the data path down with it."""
+    from ..common.config import get_config
+
+    port = get_config().metrics_port
+    if not port or port <= 0:
+        return None
+    global _server
+    with _server_lock:
+        if _server is not None:
+            return _server
+        try:
+            _server = start_metrics_server(port, role=role,
+                                           health_fn=health_fn)
+        except OSError as e:
+            bps_log.warning(
+                "metrics endpoint failed to bind port %d: %s "
+                "(continuing without)", port, e)
+            return None
+        return _server
+
+
+def stop_metrics_server() -> None:
+    """Shut the process endpoint down (tests, api.shutdown)."""
+    global _server
+    with _server_lock:
+        srv, _server = _server, None
+    if srv is not None:
+        srv.shutdown()
+        srv.server_close()

@@ -1,0 +1,180 @@
+"""Distributed per-RPC tracing: ids, context, clock-offset estimation.
+
+The reference's profiling story is per-process: the server timeline
+(``BYTEPS_SERVER_ENABLE_PROFILE``) and the client trace are separate
+files with separate clocks, so "where did THIS push_pull spend its
+time" has no answer across the wire.  This module supplies the missing
+pieces:
+
+  * **Trace ids** — 8 random bytes minted at the top of a client op
+    (``RemoteStore.push_pull`` / serving ``submit``) and carried in a
+    versioned wire-header extension (``engine/wire.py``) to the server,
+    which stamps them on its own spans.  The id is the join key
+    ``scripts/trace_merge.py`` correlates on.
+  * **Context** — a thread-local current-id so every frame a client op
+    encodes (parts, retries) carries the op's one id without plumbing
+    an argument through six layers.
+  * **Clock offset estimation** — NTP-style midpoint sampling over the
+    PS ``OP_PING`` round-trip (the reply carries the server's wall
+    clock since this PR): ``offset = t_server - (t_send + t_recv)/2``,
+    minimum-RTT sample wins.  Both the client Tracer and the server
+    profiler stamp wall-clock-anchored timestamps, so applying the
+    offset maps server spans onto the client's timeline.
+
+Enabled per ``BYTEPS_TRACE_RPC`` (tri-state: unset = auto, on exactly
+when ``BYTEPS_TRACE_PATH`` tracing is on).  Forward compatibility is
+loud — a new decoder raises on an unknown extension version — but a
+PRE-extension server misparses extended frames (it reads the whole
+frame before dispatching on op, so the inserted bytes desync its
+length fields): force ``BYTEPS_TRACE_RPC=0`` when tracing a client
+against older shards.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+__all__ = [
+    "mint_trace_id", "current_trace_id", "trace_context", "trace_id_hex",
+    "rpc_tracing_enabled", "estimate_clock_offset", "ClockOffset",
+]
+
+_TID_BYTES = 8
+_ctx = threading.local()
+
+
+def mint_trace_id() -> bytes:
+    """8 random bytes — wide enough that a merge across a cluster-day
+    of traces has no realistic collision, small enough to ride every
+    frame.  Minted from a thread-local PRNG seeded once from
+    ``os.urandom`` (urandom itself is a syscall per call — two orders
+    of magnitude over a PRNG draw under a sandboxed kernel, and minting
+    sits on every traced client op)."""
+    rng = getattr(_ctx, "rng", None)
+    if rng is None:
+        import random
+
+        rng = _ctx.rng = random.Random(os.urandom(16))
+    return rng.getrandbits(8 * _TID_BYTES).to_bytes(_TID_BYTES, "little")
+
+
+def current_trace_id() -> bytes:
+    """The thread's active trace id (b"" outside any trace context)."""
+    return getattr(_ctx, "tid", b"")
+
+
+def trace_id_hex(tid: bytes) -> str:
+    return tid.hex() if tid else ""
+
+
+@contextmanager
+def trace_context(tid: Optional[bytes] = None):
+    """Bind a trace id to this thread for the duration.  ``None`` mints
+    a fresh id *unless* one is already active — nested ops (a pull
+    inside a push_pull's recovery path) join their parent's trace
+    instead of forking a new one.  Yields the active id."""
+    prev = getattr(_ctx, "tid", b"")
+    if tid is None:
+        tid = prev or mint_trace_id()
+    _ctx.tid = tid
+    try:
+        yield tid
+    finally:
+        _ctx.tid = prev
+
+
+def rpc_tracing_enabled(cfg=None) -> bool:
+    """Should client ops mint ids and extend wire frames?
+    ``BYTEPS_TRACE_RPC`` forces either way; auto = on iff the chrome
+    tracer is on (ids without a trace file help nobody, and a
+    pre-extension server cannot parse extended frames — see the module
+    doc)."""
+    if cfg is None:
+        from ..common.config import get_config
+
+        cfg = get_config()
+    if cfg.trace_rpc is not None:
+        return cfg.trace_rpc
+    return bool(cfg.trace_path)
+
+
+# ------------------------------------------------------------ clock offsets
+
+
+class ClockOffset:
+    """One shard's estimated clock offset: ``t_server - t_client`` in
+    seconds, plus the RTT of the winning (minimum-RTT) sample — the
+    classic quality bound: the true offset lies within ±rtt/2."""
+
+    __slots__ = ("addr", "offset_s", "rtt_s", "samples")
+
+    def __init__(self, addr: str, offset_s: float, rtt_s: float,
+                 samples: int):
+        self.addr = addr
+        self.offset_s = offset_s
+        self.rtt_s = rtt_s
+        self.samples = samples
+
+    @property
+    def offset_us(self) -> float:
+        return self.offset_s * 1e6
+
+    def as_dict(self) -> Dict[str, float]:
+        return {"addr": self.addr, "offset_us": self.offset_us,
+                "rtt_us": self.rtt_s * 1e6, "samples": self.samples}
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return (f"ClockOffset({self.addr}, offset={self.offset_s * 1e3:.3f}ms,"
+                f" rtt={self.rtt_s * 1e3:.3f}ms)")
+
+
+def estimate_clock_offset(addr: str, n: int = 5,
+                          timeout: float = 2.0) -> ClockOffset:
+    """NTP-style offset of one PS shard's wall clock vs ours.
+
+    Each sample is one ``OP_PING`` round-trip on a fresh short-lived
+    connection (never the pipelined data sockets — a mid-window probe
+    would poison FIFO matching).  The server's reply payload carries its
+    ``time.time()`` at serve time; the midpoint estimator assumes the
+    two wire legs are symmetric, so the minimum-RTT sample (least
+    queueing) wins.
+    """
+    import socket as _socket
+
+    from ..engine.ps_server import OP_PING, _decode, _encode
+
+    host, port = addr.rsplit(":", 1)
+    best: Optional[ClockOffset] = None
+    got = 0
+    for _ in range(max(1, n)):
+        try:
+            with _socket.create_connection((host, int(port)),
+                                           timeout=timeout) as s:
+                s.settimeout(timeout)
+                t0 = time.time()
+                s.sendall(_encode(OP_PING, "", None))
+                status, _, _, payload = _decode(s)
+                t1 = time.time()
+        except (OSError, ValueError, struct.error):
+            continue
+        if status != 0 or len(payload) < 8:
+            # pre-extension server: PING acks without a timestamp —
+            # no offset is measurable, and pretending 0 would be a lie
+            continue
+        (t_server,) = struct.unpack_from("<d", payload)
+        got += 1
+        rtt = t1 - t0
+        offset = t_server - (t0 + t1) / 2.0
+        if best is None or rtt < best.rtt_s:
+            best = ClockOffset(addr, offset, rtt, 0)
+    if best is None:
+        raise ConnectionError(
+            f"clock offset: no timestamped PING reply from {addr} "
+            f"(shard down, or a pre-OP_STATS server?)")
+    best.samples = got
+    return best

@@ -53,7 +53,15 @@ Fault = Union[str, Tuple[str, float], None]
 def _read_frame(sock: socket.socket) -> bytes:
     """Read one complete wire frame (request or reply — same layout)."""
     head = _recv_exact(sock, 5)
-    _, nlen = struct.unpack("<BI", head)
+    op, nlen = struct.unpack("<BI", head)
+    ext = b""
+    if op & 0x80:
+        # versioned header extension (trace ids, engine/wire.py): the
+        # proxy relays any version opaquely — u8 ver | u8 len | body —
+        # so a fault-injected run can still be traced end to end
+        ext_head = _recv_exact(sock, 2)
+        (_, elen) = struct.unpack("<BB", ext_head)
+        ext = bytes(ext_head) + bytes(_recv_exact(sock, elen))
     name = _recv_exact(sock, nlen)
     dlen_b = _recv_exact(sock, 4)
     (dlen,) = struct.unpack("<I", dlen_b)
@@ -64,7 +72,8 @@ def _read_frame(sock: socket.socket) -> bytes:
     plen_b = _recv_exact(sock, 8)
     (plen,) = struct.unpack("<Q", plen_b)
     payload = _recv_exact(sock, plen)
-    return head + name + dlen_b + dt + ndim_b + shape + plen_b + payload
+    return (head + ext + name + dlen_b + dt + ndim_b + shape + plen_b
+            + payload)
 
 
 class FaultInjectingProxy:

@@ -1,12 +1,16 @@
-"""Resilience event counters, surfaced through the process Tracer.
+"""Resilience event counters, surfaced through the metrics registry.
 
 One process-wide ``ResilienceCounters`` instance (``get_counters()``)
-accumulates named monotonic counts.  Every bump also emits two
-chrome-trace events onto the shared ``Tracer`` when ``BYTEPS_TRACE_PATH``
-is set: an instant event (the moment the retry/failover happened, with
-its args) and a counter event (the running total as a value track) — so
-resilience activity lands on the same timeline the engine's push/pull
-spans already use (the operator story of reference docs/timeline.md).
+accumulates named monotonic counts.  Since PR 6 the counts live in the
+shared :class:`~byteps_tpu.observability.metrics.MetricsRegistry` (the
+global instance for ``get_counters()``, a private one per standalone
+``ResilienceCounters()``), so a live ``/metrics`` or ``OP_STATS``
+scrape sees retry/failover activity as it happens.  The pre-registry
+Tracer behavior is preserved: every bump still emits an instant event
+(the moment the retry/failover happened, with its args) and a counter
+event (the running total as a value track) onto the shared chrome-trace
+timeline when ``BYTEPS_TRACE_PATH`` is set — the operator story of
+reference docs/timeline.md is unchanged.
 """
 
 from __future__ import annotations
@@ -15,6 +19,7 @@ import threading
 from typing import Dict, Optional
 
 from ..common import logging as bps_log
+from ..observability.metrics import MetricsRegistry, get_registry
 
 # canonical counter names (free-form names are allowed; these are the
 # ones the subsystem itself emits)
@@ -37,41 +42,42 @@ TASK_FAILURE = "resilience.engine_task_failure"
 
 
 class ResilienceCounters:
-    """Thread-safe monotonic counters with Tracer surfacing."""
+    """Thread-safe monotonic counters, registry-backed.
 
-    def __init__(self, tracer=None):
-        self._counts: Dict[str, int] = {}
+    ``registry=None`` builds a private :class:`MetricsRegistry` —
+    isolated counting for tests/benches, the semantics standalone
+    instances always had.  ``get_counters()`` binds the process-global
+    registry so the scrape endpoints see resilience activity."""
+
+    def __init__(self, tracer=None, registry: Optional[MetricsRegistry]
+                 = None):
+        self._registry = (registry if registry is not None
+                          else MetricsRegistry(tracer=tracer))
+        # names this instance has bumped: snapshot() reports exactly
+        # what went through *this* instance, even on a shared registry
+        self._names: Dict[str, None] = {}
         self._lock = threading.Lock()
-        self._tracer = tracer
 
-    def _get_tracer(self):
-        if self._tracer is not None:
-            return self._tracer
-        from ..common.tracing import get_tracer
-
-        return get_tracer()
+    @property
+    def registry(self) -> MetricsRegistry:
+        return self._registry
 
     def bump(self, counter: str, n: int = 1, **args) -> int:
         with self._lock:
-            total = self._counts.get(counter, 0) + n
-            self._counts[counter] = total
-        tracer = self._get_tracer()
-        if tracer.enabled:
-            # "name" would collide with instant()'s own first parameter
-            safe = {("tensor" if k == "name" else k): v
-                    for k, v in args.items()}
-            tracer.instant(counter, "resilience", **safe)
-            tracer.counter(counter, total, "resilience")
+            self._names.setdefault(counter, None)
+        total = self._registry.counter(counter, track="resilience").inc(
+            n, **args)
         bps_log.debug("%s -> %d %s", counter, total, args or "")
         return total
 
     def get(self, name: str) -> int:
-        with self._lock:
-            return self._counts.get(name, 0)
+        m = self._registry.get(name)
+        return m.value if m is not None else 0
 
     def snapshot(self) -> Dict[str, int]:
         with self._lock:
-            return dict(self._counts)
+            names = list(self._names)
+        return {n: self.get(n) for n in names}
 
 
 _counters: Optional[ResilienceCounters] = None
@@ -82,11 +88,18 @@ def get_counters() -> ResilienceCounters:
     global _counters
     with _counters_lock:
         if _counters is None:
-            _counters = ResilienceCounters()
+            _counters = ResilienceCounters(registry=get_registry())
         return _counters
 
 
 def reset_counters() -> None:
+    """Forget the singleton AND its counts.  The backing metrics live in
+    the process-global registry, which outlives the singleton — without
+    explicit removal a rebuilt ``get_counters()`` would resolve the same
+    metric objects and report pre-reset totals."""
     global _counters
     with _counters_lock:
-        _counters = None
+        inst, _counters = _counters, None
+    if inst is not None:
+        for n in inst.snapshot():
+            inst.registry.remove(n)

@@ -112,6 +112,12 @@ class Request:
     _prefix_digs: Optional[List[bytes]] = dataclasses.field(
         default=None, repr=False)
     _task: Optional[object] = dataclasses.field(default=None, repr=False)
+    # distributed tracing (docs/observability.md): hex trace id minted
+    # at submit when RPC tracing is on; the request's serve span
+    # carries it so trace_merge can line serving work up with the PS
+    # ops the same logical operation issued
+    trace_id: str = ""
+    _t_pc: float = dataclasses.field(default=0.0, repr=False)
     t_submit: float = 0.0
     t_admit: float = 0.0
     t_first: float = 0.0
@@ -316,6 +322,11 @@ class ServingEngine:
         self.scheduler = ServeScheduler(
             max_queue=max_queue, credit_budget=budget)
         self.metrics = metrics if metrics is not None else get_serve_metrics()
+        # per-request trace ids (docs/observability.md) — resolved once;
+        # submit pays one attribute check when tracing is off
+        from ..observability.trace import rpc_tracing_enabled
+
+        self._trace_rpc = rpc_tracing_enabled()
 
         self._lock = threading.RLock()
         self._req_seq = 0
@@ -533,6 +544,14 @@ class ServingEngine:
             req = Request(id=self._req_seq, prompt=prompt,
                           max_new_tokens=max_new_tokens, seed=seed,
                           priority=priority, t_submit=time.monotonic())
+            if self._trace_rpc:
+                # join the caller's active trace (a submit inside a
+                # traced client op) or mint a fresh id for this request
+                from ..observability.trace import (current_trace_id,
+                                                   mint_trace_id)
+
+                req.trace_id = (current_trace_id() or mint_trace_id()).hex()
+                req._t_pc = time.perf_counter()
             self._outstanding += 1
             try:
                 req._task = self.scheduler.submit(req, bucket)
@@ -637,6 +656,9 @@ class ServingEngine:
                 or self.scheduler.depth:
             self.metrics.observe_tick(self.pool.occupancy(),
                                       self.scheduler.depth, emitted)
+            # live credit level (post-return = the budget the next
+            # tick's admission scan starts from)
+            self.metrics.gauge(sm.PREFILL_CREDITS, self.scheduler.credits)
         return {"admitted": len(granted), "emitted": emitted,
                 "active": self.pool.active_count,
                 "queued": self.scheduler.depth,
@@ -832,6 +854,19 @@ class ServingEngine:
 
     def _finish(self, req: Request, state: RequestState) -> None:
         req.state = state
+        if req.trace_id:
+            # the request's whole-lifetime span, stamped with its trace
+            # id — the serving-side anchor trace_merge's by-trace view
+            # groups client/server spans under
+            from ..common.tracing import get_tracer
+
+            tracer = get_tracer()
+            if tracer.enabled:
+                tracer.complete(
+                    f"serve:req{req.id}", "serve", req._t_pc,
+                    time.perf_counter() - req._t_pc,
+                    trace_id=req.trace_id, state=state.value,
+                    tokens=len(req.tokens))
         if req.slot is not None:
             self._prefilling.pop(req.slot, None)
             self._slot_req[req.slot] = None

@@ -115,7 +115,11 @@ class _ServeHandler(socketserver.BaseRequestHandler):
                              "queue_depth": engine.scheduler.depth,
                              "prefix_cache": (engine.prefix.stats()
                                               if engine.prefix is not None
-                                              else None)})
+                                              else None),
+                             # the same registry snapshot /metrics.json
+                             # serves — one stats surface, two transports
+                             # (docs/observability.md)
+                             "metrics": engine.metrics.registry.snapshot()})
                         reply = _encode(0, "", None, payload.encode())
                     elif op == OP_PING:
                         reply = _encode(0, "", None)
@@ -155,6 +159,14 @@ def serve(engine: ServingEngine, port: int, host: str = "0.0.0.0",
     srv = ServeFrontend((host, port), engine)
     bps_log.info("byteps_tpu serve frontend listening on %s:%d",
                  host, srv.server_address[1])
+    # live scrape endpoint (BYTEPS_METRICS_PORT; off by default) — the
+    # HTTP twin of the TCP STATS op (docs/observability.md)
+    from ..observability.scrape import maybe_start_metrics_server
+
+    maybe_start_metrics_server(
+        role="serve",
+        health_fn=lambda: {"occupancy": engine.pool.occupancy(),
+                           "queue_depth": engine.scheduler.depth})
     if in_thread:
         t = threading.Thread(target=srv.serve_forever, daemon=True)
         t.start()

@@ -1,20 +1,26 @@
-"""Serving metrics, surfaced through the process ``Tracer``.
+"""Serving metrics, registry-backed with ``Tracer`` surfacing.
 
-Same pattern as ``resilience/counters.py``: every observation bumps a
-named monotonic counter and — when ``BYTEPS_TRACE_PATH`` is set — lands
-on the shared chrome-trace timeline as a counter event (value track) so
-batch occupancy, queue depth, and token throughput render next to the
-engine's push/pull spans in Perfetto.  Per-request latency samples
-(queue wait, TTFT, TPOT) are additionally kept in-process for the
-``summary()`` percentiles the bench and the TCP STATS op report.
+Same pattern as ``resilience/counters.py``: every observation lands in
+a :class:`~byteps_tpu.observability.metrics.MetricsRegistry` (the
+process-global one for ``get_serve_metrics()`` — what ``/metrics``,
+``OP_STATS`` and the TCP STATS reply scrape live — or a private one per
+standalone ``ServeMetrics()`` so benches count in isolation).  When
+``BYTEPS_TRACE_PATH`` is set each bump also lands on the shared
+chrome-trace timeline as a counter event (value track), so batch
+occupancy, queue depth, and token throughput render next to the
+engine's push/pull spans in Perfetto — unchanged from pre-registry
+traces.  Per-request latency samples (queue wait, TTFT, TPOT) feed
+bounded-reservoir registry histograms that back the ``summary()``
+percentiles the bench and the TCP STATS op report.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, Optional
 
 from ..common import logging as bps_log
+from ..observability.metrics import MetricsRegistry, get_registry
 
 # canonical counter names
 SUBMITTED = "serve.requests_submitted"
@@ -42,56 +48,54 @@ QUEUE_DEPTH = "serve.queue_depth"
 TTFT_MS = "serve.ttft_ms"
 TPOT_MS = "serve.tpot_ms"
 QUEUE_WAIT_MS = "serve.queue_wait_ms"
-
-
-def _percentile(sorted_vals: List[float], q: float) -> float:
-    """Nearest-rank percentile on an already-sorted list."""
-    if not sorted_vals:
-        return 0.0
-    k = max(0, min(len(sorted_vals) - 1,
-                   int(round(q / 100.0 * (len(sorted_vals) - 1)))))
-    return sorted_vals[k]
+# per-request latency histograms (seconds — the summary()/scrape unit)
+QUEUE_WAIT_S = "serve.queue_wait_s"
+TTFT_S = "serve.ttft_s"
+TPOT_S = "serve.tpot_s"
+# live credit level of the prefill scheduler (padded tokens remaining)
+PREFILL_CREDITS = "serve.prefill_credits"
 
 
 class ServeMetrics:
-    """Thread-safe serving counters + latency samples with Tracer
-    surfacing."""
+    """Thread-safe serving counters + latency samples, registry-backed.
 
-    def __init__(self, tracer=None):
-        self._counts: Dict[str, int] = {}
-        self._queue_wait: List[float] = []
-        self._ttft: List[float] = []
-        self._tpot: List[float] = []
+    ``registry=None`` builds a private registry (isolated counting —
+    the semantics standalone instances always had); the
+    ``get_serve_metrics()`` singleton binds the process-global registry
+    so scrapes see the serving engine live."""
+
+    _HIST = {"queue_wait": QUEUE_WAIT_S, "ttft": TTFT_S, "tpot": TPOT_S}
+
+    def __init__(self, tracer=None,
+                 registry: Optional[MetricsRegistry] = None):
+        self._registry = (registry if registry is not None
+                          else MetricsRegistry(tracer=tracer))
+        # bumped-through-this-instance names: snapshot()/summary() report
+        # exactly this instance's series even on a shared registry
+        self._names: Dict[str, None] = {}
         self._lock = threading.Lock()
-        self._tracer = tracer
 
-    def _get_tracer(self):
-        if self._tracer is not None:
-            return self._tracer
-        from ..common.tracing import get_tracer
+    @property
+    def registry(self) -> MetricsRegistry:
+        return self._registry
 
-        return get_tracer()
+    def _hist(self, label: str):
+        return self._registry.histogram(self._HIST[label], track="serve")
 
     # ------------------------------------------------------------ counters
 
     def bump(self, counter: str, n: int = 1, **args) -> int:
         with self._lock:
-            total = self._counts.get(counter, 0) + n
-            self._counts[counter] = total
-        tracer = self._get_tracer()
-        if tracer.enabled:
-            safe = {("tensor" if k == "name" else k): v
-                    for k, v in args.items()}
-            tracer.instant(counter, "serve", **safe)
-            tracer.counter(counter, total, "serve")
+            self._names.setdefault(counter, None)
+        total = self._registry.counter(counter, track="serve").inc(n, **args)
         bps_log.debug("%s -> %d %s", counter, total, args or "")
         return total
 
     def gauge(self, name: str, value: float) -> None:
-        """Non-monotonic value track (occupancy, queue depth)."""
-        tracer = self._get_tracer()
-        if tracer.enabled:
-            tracer.counter(name, value, "serve")
+        """Non-monotonic value track (occupancy, queue depth, credit
+        levels) — stored in the registry (live scrapes) AND mirrored to
+        the Tracer value track as before."""
+        self._registry.gauge(name, track="serve").set(value)
 
     # --------------------------------------------------------- observations
 
@@ -106,11 +110,10 @@ class ServeMetrics:
                         tpot_s: Optional[float], tokens: int) -> None:
         """Record one completed request's latency profile.  ``tpot_s``
         is None for single-token requests (no inter-token gaps)."""
-        with self._lock:
-            self._queue_wait.append(queue_wait_s)
-            self._ttft.append(ttft_s)
-            if tpot_s is not None:
-                self._tpot.append(tpot_s)
+        self._hist("queue_wait").observe(queue_wait_s)
+        self._hist("ttft").observe(ttft_s)
+        if tpot_s is not None:
+            self._hist("tpot").observe(tpot_s)
         self.gauge(QUEUE_WAIT_MS, queue_wait_s * 1e3)
         self.gauge(TTFT_MS, ttft_s * 1e3)
         if tpot_s is not None:
@@ -120,26 +123,22 @@ class ServeMetrics:
     # ------------------------------------------------------------ reporting
 
     def get(self, name: str) -> int:
-        with self._lock:
-            return self._counts.get(name, 0)
+        m = self._registry.get(name)
+        return m.value if m is not None else 0
 
     def snapshot(self) -> Dict[str, int]:
         with self._lock:
-            return dict(self._counts)
+            names = list(self._names)
+        return {n: self.get(n) for n in names}
 
     def summary(self) -> Dict[str, object]:
         """Counters plus latency percentiles (seconds)."""
-        with self._lock:
-            counts = dict(self._counts)
-            qw = sorted(self._queue_wait)
-            ttft = sorted(self._ttft)
-            tpot = sorted(self._tpot)
-        out: Dict[str, object] = dict(counts)
-        for label, vals in (("queue_wait", qw), ("ttft", ttft),
-                            ("tpot", tpot)):
-            out[f"{label}_p50_s"] = _percentile(vals, 50)
-            out[f"{label}_p99_s"] = _percentile(vals, 99)
-            out[f"{label}_n"] = len(vals)
+        out: Dict[str, object] = dict(self.snapshot())
+        for label in ("queue_wait", "ttft", "tpot"):
+            h = self._hist(label)
+            out[f"{label}_p50_s"] = h.percentile(50)
+            out[f"{label}_p99_s"] = h.percentile(99)
+            out[f"{label}_n"] = h.count
         return out
 
 
@@ -151,11 +150,20 @@ def get_serve_metrics() -> ServeMetrics:
     global _metrics
     with _metrics_lock:
         if _metrics is None:
-            _metrics = ServeMetrics()
+            _metrics = ServeMetrics(registry=get_registry())
         return _metrics
 
 
 def reset_serve_metrics() -> None:
+    """Forget the singleton AND its counts.  The backing metrics live in
+    the process-global registry, which outlives the singleton, so the
+    ``serve.*`` namespace (counters, gauges, latency histograms) is
+    removed explicitly — otherwise a rebuilt ``get_serve_metrics()``
+    would report the previous run's totals and percentile samples."""
     global _metrics
     with _metrics_lock:
-        _metrics = None
+        inst, _metrics = _metrics, None
+    if inst is not None:
+        inst.registry.remove_prefix("serve.")
+        for n in inst.snapshot():  # free-form names outside serve.*
+            inst.registry.remove(n)

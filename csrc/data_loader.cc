@@ -12,10 +12,14 @@
 // Design: classic bounded ring with two index queues (free / ready) under
 // one mutex + two condition variables.  Worker threads draw a batch slot
 // and a position in the (per-epoch reshuffled) permutation from a shared
-// cursor, gather the sample rows, and publish the slot.  Batch order
-// across threads is nondeterministic by design (like any multi-worker
-// loader); with num_threads=1 the stream is exactly the seeded
-// permutation — the determinism contract the tests pin down.
+// cursor, gather the sample rows, and publish the slot.  Batches are
+// DELIVERED in claim order (each claim takes a sequence number under the
+// lock; acquire hands out slot seq 0, 1, 2, ... via a min-heap), so the
+// consumer stream equals the single-threaded seeded permutation no
+// matter how many workers fill it.  Completion order used to decide
+// delivery instead, which let a fast first-batch-of-epoch-N+1 overtake a
+// straggling last-batch-of-epoch-N and break the one-epoch completeness
+// contract (a duplicated sample and a lost one per overtake).
 //
 // C ABI only (ctypes; no pybind11 in this image).
 
@@ -24,6 +28,7 @@
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
+#include <functional>
 #include <mutex>
 #include <queue>
 #include <random>
@@ -54,7 +59,14 @@ struct Loader {
   int64_t out_bytes_per_batch = 0;
   std::vector<std::vector<uint8_t>> slots;
   std::vector<std::vector<int32_t>> slot_labels;
-  std::queue<int> free_q, ready_q;
+  std::queue<int> free_q;
+  // filled slots keyed by claim sequence; acquire() only pops the heap
+  // top when it IS next_deliver, so delivery order == claim order
+  std::priority_queue<std::pair<int64_t, int>,
+                      std::vector<std::pair<int64_t, int>>,
+                      std::greater<std::pair<int64_t, int>>> ready_q;
+  int64_t next_seq = 0;      // claim-time sequence stamp
+  int64_t next_deliver = 0;  // sequence the consumer gets next
 
   // permutation cursor
   std::vector<int64_t> perm;
@@ -95,6 +107,7 @@ struct Loader {
     std::vector<int64_t> idx((size_t)batch_size);
     for (;;) {
       int slot;
+      int64_t seq;
       {
         std::unique_lock<std::mutex> lk(mu);
         cv_free.wait(lk, [&] { return stopping || !free_q.empty(); });
@@ -110,13 +123,17 @@ struct Loader {
         }
         for (int64_t b = 0; b < batch_size; ++b)
           idx[(size_t)b] = perm[(size_t)cursor++];
+        seq = next_seq++;
       }
       fill(slot, idx.data());
       {
         std::lock_guard<std::mutex> lk(mu);
-        ready_q.push(slot);
+        ready_q.emplace(seq, slot);
       }
-      cv_ready.notify_one();
+      // notify_all: the waiter that can make progress is the consumer
+      // whose turn (next_deliver) this seq is, not necessarily the
+      // longest-waiting one
+      cv_ready.notify_all();
     }
   }
 };
@@ -173,15 +190,23 @@ int bps_loader_acquire(void* loader, uint8_t** out_data,
   auto* L = static_cast<Loader*>(loader);
   std::unique_lock<std::mutex> lk(L->mu);
   ++L->consumers_in_acquire;
-  L->cv_ready.wait(lk, [&] { return L->stopping || !L->ready_q.empty(); });
+  // in-order delivery: wait for the batch whose claim seq is next, not
+  // just for ANY filled slot (claimed batches are bounded by the ring
+  // depth, so the missing seq is always being filled by some worker)
+  L->cv_ready.wait(lk, [&] {
+    return L->stopping || (!L->ready_q.empty() &&
+                           L->ready_q.top().first == L->next_deliver);
+  });
   int slot = -1;
-  if (!L->stopping && !L->ready_q.empty()) {
+  if (!L->stopping) {
     // never hand out a slot once stopping: destroy frees the ring as soon
     // as consumers drain, so returned pointers would dangle
-    slot = L->ready_q.front();
+    slot = L->ready_q.top().second;
     L->ready_q.pop();
+    ++L->next_deliver;
     *out_data = L->slots[slot].data();
     *out_labels = L->slot_labels[slot].data();
+    L->cv_ready.notify_all();  // the consumer owed the new next_deliver
   }
   if (--L->consumers_in_acquire == 0 && L->stopping)
     L->cv_drained.notify_all();

@@ -108,6 +108,22 @@ def run(requests: int = 12, seed: int = 0, n_slots: int = 4,
         model, variables, n_slots=n_slots, max_seq=cfg.max_seq_len,
         temperature=temperature, metrics=ServeMetrics(), **engine_kw)
     engine.start()
+    # BYTEPS_METRICS_PORT makes the smoke live-scrapeable: the endpoint
+    # is bound to THIS engine's (private) registry so a mid-run curl of
+    # /metrics sees the smoke's own TTFT/occupancy series
+    # (docs/observability.md)
+    metrics_srv = None
+    from byteps_tpu.common.config import get_config
+
+    metrics_port = get_config().metrics_port
+    if metrics_port > 0:
+        from byteps_tpu.observability.scrape import start_metrics_server
+
+        metrics_srv = start_metrics_server(
+            metrics_port, role="serve_smoke",
+            registry=engine.metrics.registry,
+            health_fn=lambda: {"occupancy": engine.pool.occupancy(),
+                               "queue_depth": engine.scheduler.depth})
     results = [None] * requests
     errors = []
 
@@ -130,6 +146,9 @@ def run(requests: int = 12, seed: int = 0, n_slots: int = 4,
         t.join()
     engine.drain(timeout=300)
     engine.stop()
+    if metrics_srv is not None:
+        metrics_srv.shutdown()
+        metrics_srv.server_close()
     assert not errors, f"submit failures: {errors}"
 
     mismatches = 0

@@ -1,0 +1,104 @@
+"""Merge client + server trace files into ONE Perfetto timeline.
+
+The client ``Tracer`` (BYTEPS_TRACE_PATH) and each PS shard's
+``ServerProfiler`` (BYTEPS_SERVER_ENABLE_PROFILE) write separate files
+with separate clocks.  Both stamp wall-clock-anchored timestamps since
+PR 6, so after subtracting each server host's measured clock offset
+(``RemoteStore.record_clock_offsets()`` drops the NTP-style estimates
+into the client trace as ``clock_offset`` instant events) every span
+lives on the client's time axis — and the per-RPC trace ids the wire
+frames carry let Perfetto show one push_pull's client-queue/wire/server
+spans correlated under one id.
+
+Usage::
+
+    python scripts/trace_merge.py --client client.json \
+        --server 127.0.0.1:7100=server0_profile.json \
+        --server 127.0.0.1:7101=server1_profile.json \
+        -o merged.json --by-trace
+
+Offsets come from the client trace's ``clock_offset`` events (keyed by
+the ``addr`` given on --server); ``--offset addr=microseconds``
+overrides, ``--no-align`` disables alignment entirely (raw clocks).
+Load the output at https://ui.perfetto.dev or chrome://tracing; with
+``--by-trace`` an extra "by-trace-id" process groups every span that
+carries a trace id onto one row per id.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from byteps_tpu.observability.export import (  # noqa: E402
+    clock_offsets_from_events, load_trace_events, merge_traces, write_trace)
+
+
+def run(client: str, servers, out: str, by_trace: bool = False,
+        overrides=None, align: bool = True) -> dict:
+    client_events = load_trace_events(client)
+    offsets = clock_offsets_from_events(client_events) if align else {}
+    offsets.update(overrides or {})
+    sources = [("client", client_events, 0.0)]
+    matched = 0
+    for addr, path in servers:
+        off = offsets.get(addr, 0.0) if align else 0.0
+        if align and addr in offsets:
+            matched += 1
+        elif align:
+            print(f"warning: no clock_offset event for {addr} in "
+                  f"{client} — merging its spans unaligned (did the "
+                  f"client call record_clock_offsets()?)", file=sys.stderr)
+        sources.append((f"server {addr}", load_trace_events(path), off))
+    doc = merge_traces(sources, by_trace=by_trace)
+    n_ids = len({ev.get("args", {}).get("trace_id")
+                 for ev in doc["traceEvents"]
+                 if ev.get("args", {}).get("trace_id")})
+    write_trace(doc, out)
+    print(f"merged {len(sources)} traces -> {out}: "
+          f"{len(doc['traceEvents'])} events, {n_ids} distinct trace ids, "
+          f"{matched}/{len(servers)} servers clock-aligned")
+    return doc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge client/server chrome traces onto one timeline")
+    ap.add_argument("--client", required=True,
+                    help="client trace (BYTEPS_TRACE_PATH output) — the "
+                         "reference clock")
+    ap.add_argument("--server", action="append", default=[],
+                    metavar="ADDR=PATH",
+                    help="one PS shard profile (BYTEPS_SERVER_PROFILE_"
+                         "OUTPUT_PATH), keyed by the addr the client "
+                         "dialed (repeatable)")
+    ap.add_argument("-o", "--out", default="merged_trace.json")
+    ap.add_argument("--by-trace", action="store_true",
+                    help="add a per-trace-id row group (follow one "
+                         "push_pull end to end)")
+    ap.add_argument("--offset", action="append", default=[],
+                    metavar="ADDR=MICROSECONDS",
+                    help="override a shard's clock offset (else read "
+                         "from the client trace's clock_offset events)")
+    ap.add_argument("--no-align", action="store_true",
+                    help="skip clock alignment (raw per-host clocks)")
+    args = ap.parse_args(argv)
+
+    def split(spec, cast):
+        addr, _, v = spec.rpartition("=")
+        if not addr:
+            ap.error(f"expected ADDR=VALUE, got {spec!r}")
+        return addr, cast(v)
+
+    servers = [split(s, str) for s in args.server]
+    overrides = dict(split(s, float) for s in args.offset)
+    run(args.client, servers, args.out, by_trace=args.by_trace,
+        overrides=overrides, align=not args.no_align)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,163 @@
+"""Summarize a trace or metrics dump — the post-mortem half of
+docs/observability.md's straggler workflow.
+
+Feed it any file the observability layer emits and it prints the right
+summary:
+
+  * a chrome trace (client ``Tracer`` output, a ``ServerProfiler``
+    profile, or a ``trace_merge.py`` merge): top-k slowest span names
+    (count / total / mean / max), a per-stage time breakdown (how much
+    of the run went to client-queue vs wire vs server handling), and a
+    window-stall view — the distribution of ``wire.window_occupancy``
+    counter samples plus the client-queue wait histogram (a send
+    stalled behind a full window sits in client-queue).
+  * a metrics dump (``/metrics.json``, ``OP_STATS`` / serving STATS
+    reply, or any registry ``snapshot()``): counters, gauges, and
+    histogram percentiles, sorted.
+
+Usage::
+
+    python scripts/trace_report.py trace.json [--top 10]
+    python scripts/trace_report.py metrics.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from byteps_tpu.observability.export import (  # noqa: E402
+    load_trace_events, span_durations)
+
+
+def _fmt_us(us: float) -> str:
+    if us >= 1e6:
+        return f"{us / 1e6:.3f}s"
+    if us >= 1e3:
+        return f"{us / 1e3:.3f}ms"
+    return f"{us:.0f}us"
+
+
+def _hist_line(values, bins=8) -> str:
+    """One-line ASCII histogram of ``values`` (equal-width bins)."""
+    if not values:
+        return "(no samples)"
+    lo, hi = min(values), max(values)
+    if hi <= lo:
+        return f"{len(values)} samples, all {lo:.3g}"
+    counts = [0] * bins
+    for v in values:
+        i = min(bins - 1, int((v - lo) / (hi - lo) * bins))
+        counts[i] += 1
+    peak = max(counts)
+    bars = "".join(" ▁▂▃▄▅▆▇█"[min(8, round(c / peak * 8))] for c in counts)
+    return f"[{lo:.3g} .. {hi:.3g}] |{bars}| n={len(values)}"
+
+
+def report_trace(events, top: int = 10, out=sys.stdout) -> dict:
+    # a --by-trace merged trace carries a SECOND copy of every
+    # trace-id span under a synthetic "by-trace-id" process row —
+    # skip those pids or every such span double-counts and the trace
+    # ids show up as pseudo-stages
+    synth = {ev.get("pid") for ev in events
+             if ev.get("ph") == "M"
+             and ev.get("args", {}).get("name") == "by-trace-id"}
+    if synth:
+        events = [ev for ev in events if ev.get("pid") not in synth]
+    rows = span_durations(events)  # (name, stage, dur_us)
+    by_name = defaultdict(list)
+    by_stage = defaultdict(float)
+    for name, stage, dur in rows:
+        by_name[name].append(dur)
+        by_stage[stage] += dur
+    summary = {"spans": len(rows), "names": len(by_name)}
+
+    print(f"spans: {len(rows)}  distinct names: {len(by_name)}", file=out)
+    print(f"\ntop {top} slowest keys (by total span time):", file=out)
+    ranked = sorted(by_name.items(), key=lambda kv: -sum(kv[1]))[:top]
+    for name, durs in ranked:
+        print(f"  {name:<40} n={len(durs):<6} total={_fmt_us(sum(durs)):>10}"
+              f"  mean={_fmt_us(sum(durs) / len(durs)):>10}"
+              f"  max={_fmt_us(max(durs)):>10}", file=out)
+    summary["top"] = [n for n, _ in ranked]
+
+    print("\nper-stage time breakdown:", file=out)
+    total = sum(by_stage.values()) or 1.0
+    for stage, t in sorted(by_stage.items(), key=lambda kv: -kv[1]):
+        print(f"  {str(stage):<24} {_fmt_us(t):>12}  "
+              f"{t / total * 100:5.1f}%", file=out)
+    summary["stages"] = dict(by_stage)
+
+    # window stalls: occupancy counter samples + client-queue waits
+    # mirrored series names carry labels ("wire.window_occupancy{shard=0}")
+    occ = [float(ev["args"]["value"]) for ev in events
+           if ev.get("ph") == "C"
+           and "window_occupancy" in str(ev.get("name", ""))]
+    if occ:
+        full = sum(1 for v in occ if v >= 1.0)
+        print(f"\nwire window occupancy ({len(occ)} samples, "
+              f"{full} at window-full):", file=out)
+        print(f"  {_hist_line(occ)}", file=out)
+        summary["window_full_samples"] = full
+    queue_waits = [d for n, s, d in rows if s == "client-queue"]
+    if queue_waits:
+        print(f"\nclient-queue wait (us) — frames stalled behind the "
+              f"window sit here:", file=out)
+        print(f"  {_hist_line(queue_waits)}", file=out)
+    return summary
+
+
+def report_metrics(doc: dict, out=sys.stdout) -> dict:
+    # accept a bare snapshot or a wrapper that carries one ("metrics"
+    # key: OP_STATS and the serving STATS reply)
+    snap = doc.get("metrics", doc) if isinstance(doc, dict) else {}
+    if not isinstance(snap, dict) or "counters" not in snap:
+        snap = {"counters": {}, "gauges": {}, "histograms": {}}
+    for label in ("role", "uptime_s", "tensors"):
+        if isinstance(doc, dict) and label in doc:
+            print(f"{label}: {doc[label]}", file=out)
+    if snap.get("counters"):
+        print("\ncounters:", file=out)
+        for k, v in sorted(snap["counters"].items()):
+            print(f"  {k:<52} {v}", file=out)
+    if snap.get("gauges"):
+        print("\ngauges:", file=out)
+        for k, v in sorted(snap["gauges"].items()):
+            print(f"  {k:<52} {v:g}", file=out)
+    if snap.get("histograms"):
+        print("\nhistograms:", file=out)
+        for k, st in sorted(snap["histograms"].items()):
+            print(f"  {k:<40} n={st['count']:<7} sum={st['sum']:.4g}  "
+                  f"p50={st['p50']:.4g} p90={st['p90']:.4g} "
+                  f"p99={st['p99']:.4g}", file=out)
+    return snap
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="summarize a byteps_tpu trace or metrics dump")
+    ap.add_argument("path")
+    ap.add_argument("--top", type=int, default=10,
+                    help="how many slowest keys to list (trace mode)")
+    args = ap.parse_args(argv)
+    with open(args.path) as f:
+        try:
+            doc = json.load(f)
+        except ValueError:
+            doc = None
+    # a metrics dump is a dict with a counters/metrics key; anything
+    # else (object-form trace, bare/unterminated array) is a trace
+    if isinstance(doc, dict) and ("counters" in doc or "metrics" in doc):
+        report_metrics(doc)
+    else:
+        report_trace(load_trace_events(args.path), top=args.top)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

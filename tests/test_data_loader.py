@@ -74,10 +74,19 @@ def test_multithread_epoch_no_lost_samples():
     data, labels = _dataset()
     loader = NativeLoader(data, labels, batch_size=4, shuffle=True,
                           num_threads=4, depth=8, seed=1)
-    # one epoch's worth of batches, any order across threads
+    # one epoch's worth of batches — delivery is claim-ordered
+    # (csrc/data_loader.cc), so 16 batches are EXACTLY epoch 0: a
+    # fast epoch-1 batch can never overtake a straggling epoch-0 one
+    # and duplicate/lose samples across the boundary
     seen = np.concatenate([loader.next()["label"] for _ in range(16)])
     loader.close()
     assert sorted(seen.tolist()) == list(range(N))
+    # stronger: the multi-thread stream IS the single-thread stream
+    ref = NativeLoader(data, labels, batch_size=4, shuffle=True,
+                       num_threads=1, depth=8, seed=1)
+    expect = np.concatenate([ref.next()["label"] for _ in range(16)])
+    ref.close()
+    np.testing.assert_array_equal(seen, expect)
 
 
 def test_zero_copy_mode_view_then_invalidate():
