@@ -20,8 +20,12 @@ single-chip bench.py cannot:
   * **pipelined wire** (PR 4, docs/wire.md) — serial vs windowed
     ``RemoteStore.push_pull`` against 4 real PS shard processes with
     a >=4-partition tensor, on raw loopback AND on an emulated
-    5 ms/hop wire; archived into BENCH_COMM.json
-    (``--wire-only`` runs just this A/B).
+    5 ms/hop wire; archived into BENCH_COMM.json (these rows stay
+    pinned to TCP so the longitudinal comparison holds);
+  * **endpoint transports** (docs/wire.md "Transports") — same-host
+    tcp vs unix vs shm A/B on single-frame ``pull``/``push_pull``
+    round trips against one real shard process (``--transports-only``
+    runs just this; ``--wire-only`` runs both wire benches).
 
 Prints ONE JSON line per point.  Runs anywhere (CPU virtual mesh by
 construction):  python bench_comm.py [--layers 8 --dim 1024]
@@ -57,6 +61,28 @@ import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 import optax  # noqa: E402
 from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
+
+
+def _free_port():
+    import socket as _socket
+
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _wait_port(p):
+    import socket as _socket
+
+    for _ in range(150):
+        try:
+            _socket.create_connection(("127.0.0.1", p), timeout=0.2).close()
+            return
+        except OSError:
+            time.sleep(0.2)
+    raise RuntimeError(f"PS shard on :{p} never came up")
 
 
 def _time(fn, state, batch, iters, warmup=2):
@@ -307,28 +333,7 @@ def pipelined_wire(mb=8, part_kb=1024, shards=4, delay_ms=5.0, reps=8,
     from byteps_tpu.engine import ps_server
     from byteps_tpu.resilience import FaultInjectingProxy
 
-    def free_port():
-        import socket as _socket
-
-        s = _socket.socket()
-        s.bind(("127.0.0.1", 0))
-        p = s.getsockname()[1]
-        s.close()
-        return p
-
-    def wait_port(p):
-        import socket as _socket
-
-        for _ in range(150):
-            try:
-                _socket.create_connection(("127.0.0.1", p),
-                                          timeout=0.2).close()
-                return
-            except OSError:
-                time.sleep(0.2)
-        raise RuntimeError(f"PS shard on :{p} never came up")
-
-    ports = [free_port() for _ in range(shards)]
+    ports = [_free_port() for _ in range(shards)]
     procs = []
     rows = []
     saved_cfg = get_config()
@@ -341,7 +346,7 @@ def pipelined_wire(mb=8, part_kb=1024, shards=4, delay_ms=5.0, reps=8,
                  f"use_native=False)"],
                 env={**os.environ, "JAX_PLATFORMS": "cpu"}))
         for p in ports:
-            wait_port(p)
+            _wait_port(p)
         # replace(), not a fresh Config: env-derived knobs (e.g.
         # BYTEPS_WIRE_WINDOW under test) must keep applying
         set_config(dataclasses.replace(saved_cfg,
@@ -350,9 +355,15 @@ def pipelined_wire(mb=8, part_kb=1024, shards=4, delay_ms=5.0, reps=8,
         nparts = max(1, mb * 1024 // part_kb)
 
         def measure(addrs, tag):
+            # pinned to TCP: these are the longitudinal serial-vs-window
+            # A/B rows — letting BYTEPS_TRANSPORT=auto flip them onto
+            # the UDS fast path would silently change what they measure
+            # (transport_ab() below owns the per-transport comparison)
             stores = {
-                "serial": ps_server.RemoteStore(addrs, wire_window=0),
-                "pipelined": ps_server.RemoteStore(addrs),
+                "serial": ps_server.RemoteStore(addrs, wire_window=0,
+                                                transport="tcp"),
+                "pipelined": ps_server.RemoteStore(addrs,
+                                                   transport="tcp"),
             }
             for mode, st in stores.items():
                 st.init_tensor(f"{tag}_{mode}", np.zeros_like(x))
@@ -416,6 +427,107 @@ def pipelined_wire(mb=8, part_kb=1024, shards=4, delay_ms=5.0, reps=8,
     return rows
 
 
+def transport_ab(mb=1, reps=24, archive=True):
+    """Same-host transport A/B (docs/wire.md "Transports"): one real PS
+    shard process advertising all three endpoints, one client per
+    transport, measuring ``pull`` (one-way bulk — the wire-throughput
+    number the acceptance bar reads) and ``push_pull`` (round trip
+    incl. the server's dense add) of an ``mb``-MiB tensor as a SINGLE
+    frame.  The default 1 MiB frame is the partition-sized regime the
+    colocated client actually puts on the wire, where per-frame
+    transport cost (syscalls, TCP stack traversal, wakeup latency)
+    dominates over memcpy — exactly what a local transport exists to
+    remove.  Reps are interleaved across transports so this bursty
+    2-vCPU host's throttling hits all of them alike, and the archived
+    value is min-of-reps over a deliberately long rep count (24): the
+    host throttles in multi-second windows, so short runs can land
+    entirely inside one; ~10 reps was measurably not enough for the
+    ratio to converge."""
+    import dataclasses
+    import subprocess
+    import sys as _sys
+
+    from byteps_tpu.common.config import get_config, set_config
+    from byteps_tpu.engine import ps_server
+
+    port = _free_port()
+    saved_cfg = get_config()
+    rows = []
+    proc = None
+    transports = ("tcp", "unix", "shm")
+    try:
+        proc = subprocess.Popen(
+            [_sys.executable, "-c",
+             f"from byteps_tpu.engine import ps_server; "
+             f"ps_server.serve({port}, host='127.0.0.1', "
+             f"use_native=False)"],
+            # the shard must advertise its local endpoints even when
+            # the operator pinned BYTEPS_TRANSPORT=tcp for the client
+            # side — the unix/shm legs connect to them explicitly
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "BYTEPS_TRANSPORT": "auto"})
+        _wait_port(port)
+        addr = f"127.0.0.1:{port}"
+        # one frame per op: wire cost, not partition pipelining
+        set_config(dataclasses.replace(saved_cfg,
+                                       partition_bytes=mb * 1024 * 1024))
+        import numpy as _np
+
+        x = _np.ones(mb * 1024 * 1024 // 4, _np.float32)
+        # serial stores (window=0): the caller thread drives the wire
+        # directly, so the A/B measures transport cost, not the
+        # pipelined client's thread-handoff jitter (2 vCPUs)
+        stores = {t: ps_server.RemoteStore([addr], transport=t,
+                                           wire_window=0)
+                  for t in transports}
+        for t, st in stores.items():
+            st.init_tensor(f"ab_{t}", x)
+            st.pull(f"ab_{t}")           # warm the path (connect etc.)
+            st.push_pull(f"ab_{t}", x)
+        times = {("pull", t): [] for t in transports}
+        times.update({("push_pull", t): [] for t in transports})
+        for _ in range(reps):
+            for t, st in stores.items():
+                t0 = time.perf_counter()
+                st.pull(f"ab_{t}")
+                times[("pull", t)].append(time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                st.push_pull(f"ab_{t}", x)
+                times[("push_pull", t)].append(time.perf_counter() - t0)
+        for st in stores.values():
+            st.close()
+        for op in ("pull", "push_pull"):
+            tcp_min = min(times[(op, "tcp")])
+            for t in transports:
+                best = min(times[(op, t)])
+                moved = mb * (2 if op == "push_pull" else 1)
+                row = {
+                    "metric": f"wire_transport_{op}_{t}_{mb}mb_ms",
+                    "value": round(best * 1e3, 2),
+                    "unit": f"ms/{op}",
+                    "transport": t,
+                    "tensor_mb": mb,
+                    "mb_per_s": round(moved / best, 1),
+                    "vs_tcp_min": round(tcp_min / best, 3),
+                    "wire": "same-host, single frame",
+                    "tool": "bench_comm.py",
+                }
+                rows.append(row)
+                print(json.dumps(row), flush=True)
+    finally:
+        set_config(saved_cfg)
+        if proc is not None:
+            proc.terminate()
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=5)
+    if archive and rows:
+        _archive_rows(rows)
+    return rows
+
+
 def _archive_rows(rows, path="BENCH_COMM.json"):
     """Merge rows into BENCH_COMM.json by metric name (acceptance
     artifact: the pipelined-wire numbers live next to the PR-4-era
@@ -436,14 +548,29 @@ def main():
     ap.add_argument("--wire-delay-ms", type=float, default=5.0)
     ap.add_argument("--wire-reps", type=int, default=8)
     ap.add_argument("--wire-only", action="store_true",
-                    help="run only the pipelined-wire A/B")
+                    help="run only the pipelined-wire A/B + the "
+                         "per-transport A/B")
+    ap.add_argument("--transports-only", action="store_true",
+                    help="run only the per-transport same-host A/B")
+    # 1 MiB frames: the partition-sized regime the colocated client
+    # actually sends, where per-frame transport cost dominates; 24
+    # interleaved reps so min-of-reps escapes this host's throttle
+    # windows (see transport_ab docstring)
+    ap.add_argument("--transport-mb", type=int, default=1)
+    ap.add_argument("--transport-reps", type=int, default=24)
     ap.add_argument("--no-archive", action="store_true",
                     help="do not update BENCH_COMM.json")
     args = ap.parse_args()
 
+    if args.transports_only:
+        transport_ab(mb=args.transport_mb, reps=args.transport_reps,
+                     archive=not args.no_archive)
+        return
     pipelined_wire(mb=args.wire_mb, part_kb=args.wire_part_kb,
                    delay_ms=args.wire_delay_ms, reps=args.wire_reps,
                    archive=not args.no_archive)
+    transport_ab(mb=args.transport_mb, reps=args.transport_reps,
+                 archive=not args.no_archive)
     if args.wire_only:
         return
 

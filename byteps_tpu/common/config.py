@@ -170,6 +170,25 @@ class Config:
     # partition futures; also bounds concurrent compression encodes)
     wire_fanout: int = 16
 
+    # --- endpoint transports (byteps_tpu/engine/transport.py; the
+    # BytePSSharedMemory / BytePSCommSocket analog — a colocated client
+    # and shard skip the TCP/IP stack entirely; docs/wire.md
+    # "Transports") -------------------------------------------------------
+    # "auto" (local fast path when the endpoint advertises one, TCP
+    # otherwise) | "tcp" | "unix" | "shm"; servers advertise local
+    # endpoints unless this is "tcp"
+    transport: str = "auto"
+    # rendezvous dir for UDS sockets / shm handshakes; "" = a short
+    # per-uid dir under the system tmpdir (UDS paths are limited to
+    # ~108 bytes — overlong dirs fail loudly)
+    transport_dir: str = ""
+    # per-endpoint overrides: "host:port=spec,..." where spec is a
+    # transport name or "unix:/explicit/path.sock"
+    transport_overrides: str = ""
+    # shared-memory ring capacity per direction, MiB (each shm
+    # connection maps two rings of this size)
+    transport_shm_mb: int = 4
+
     # --- gradient wire compression (byteps_tpu/compression/; the
     # reference reserved kCompressedPushPull, common.h:212-216, and never
     # implemented it — docs/compression.md) ------------------------------
@@ -237,6 +256,10 @@ class Config:
             serve_prefix_mb=_env_int("BYTEPS_SERVE_PREFIX_MB", 256),
             wire_window=_env_int("BYTEPS_WIRE_WINDOW", 8),
             wire_fanout=_env_int("BYTEPS_WIRE_FANOUT", 16),
+            transport=_env_str("BYTEPS_TRANSPORT", "auto"),
+            transport_dir=_env_str("BYTEPS_TRANSPORT_DIR", ""),
+            transport_overrides=_env_str("BYTEPS_TRANSPORT_OVERRIDES", ""),
+            transport_shm_mb=_env_int("BYTEPS_TRANSPORT_SHM_MB", 4),
             compression=_env_str("BYTEPS_COMPRESSION", ""),
             compression_min_bytes=_env_int("BYTEPS_MIN_COMPRESS_BYTES", 1024),
             compression_overrides=_env_str(

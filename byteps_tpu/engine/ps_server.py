@@ -65,6 +65,16 @@ matching, and multi-partition ops fan their parts out concurrently
 across shards in ``ScheduledQueue`` priority order — the client half of
 the paper's keep-the-wire-busy architecture.  ``BYTEPS_WIRE_WINDOW=0``
 restores the serial one-frame-in-flight client (the A/B baseline).
+
+Endpoint transports (byteps_tpu/engine/transport.py — docs/wire.md
+"Transports"): the server listens on TCP and, unless
+``BYTEPS_TRANSPORT=tcp``, additionally advertises an AF_UNIX socket and
+a shared-memory-ring rendezvous keyed by its port (the
+``BytePSSharedMemory`` / ``BytePSCommSocket`` analog).  ``RemoteStore``
+resolves a transport per endpoint (``auto``: the local fast path for
+colocated shards, TCP otherwise) and consumes it only through the
+duck-socket interface, so the window/FIFO/retry/failover machinery is
+transport-independent by construction.
 """
 
 from __future__ import annotations
@@ -88,6 +98,9 @@ from .async_ps import AsyncParameterServer
 # framing codec + pipeline live in engine/wire.py; re-exported here
 # because the chaos proxy, the serving frontend and tests import them
 # from this module (one wire framing, one reader)
+from .transport import (LocalEndpoints, connection_kind, maybe_nodelay,
+                        parse_overrides, peer_label, resolve_transport,
+                        transport_connect)
 from .wire import (ShardWorker, _decode, _decode_frame,  # noqa: F401
                    _dtype_to_wire, _encode, _encode_buffers, _recv_exact,
                    _send_buffers, _wire_to_dtype, hard_reset)
@@ -252,9 +265,9 @@ class _Handler(socketserver.BaseRequestHandler):
         # reply-leg cast compression (BYTEPS_COMPRESSION_REPLY): identity
         # unless configured; biased schemes are refused inside the helper
         reply_c = getattr(self.server, "reply_compress", lambda a: a)
-        peer = "%s:%s" % self.client_address[:2]
+        peer = peer_label(self.client_address)
         sock = self.request
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        maybe_nodelay(sock)
         self.server.track_connection(sock)  # type: ignore[attr-defined]
         # live request accounting (process registry — what OP_STATS and
         # /metrics serve); metric objects resolved once per connection
@@ -268,6 +281,12 @@ class _Handler(socketserver.BaseRequestHandler):
                               instants=False, mirror=False)
         m_errs = _reg.counter("ps.request_errors", track="ps_server",
                               instants=False, mirror=False)
+        # per-transport RPC attribution (tcp vs the unix/shm fast
+        # paths) — the server twin of the client's labeled wire.* series
+        m_treqs = _reg.counter("ps.requests_by_transport",
+                               track="ps_server", instants=False,
+                               mirror=False,
+                               transport=connection_kind(sock))
         m_handle = _reg.histogram("ps.handle_s", track="ps_server")
         try:
             while True:
@@ -353,6 +372,7 @@ class _Handler(socketserver.BaseRequestHandler):
                     )
                 t_end = time.perf_counter()
                 m_reqs.inc()
+                m_treqs.inc()
                 if failed:
                     m_errs.inc()
                 if op in _PROFILED_OPS:
@@ -386,9 +406,27 @@ class PSServer(socketserver.ThreadingTCPServer):
             # loop; per-connection daemon threads keep serving)
             self._conns: set = set()
             self._conns_lock = threading.Lock()
+            self.local_endpoints: Optional[LocalEndpoints] = None
             from ..common.config import get_config
 
             cfg = get_config()
+            if cfg.transport != "tcp":
+                # advertise the colocated fast paths (UDS + shm
+                # rendezvous keyed by this TCP port); a client's
+                # BYTEPS_TRANSPORT=auto finds them via the shared path
+                # convention (engine/transport.py).  An overlong
+                # rendezvous path raises (loud, names the path); any
+                # other bind failure degrades to TCP-only with a
+                # warning — a shard must not die because /tmp is odd.
+                try:
+                    self.local_endpoints = LocalEndpoints(
+                        self.server_address[1], _Handler, self)
+                except ValueError:
+                    raise
+                except OSError as e:
+                    bps_log.warning(
+                        "ps_server: local transport endpoints "
+                        "unavailable (%s); serving TCP only", e)
             if cfg.compression_reply:
                 from ..compression.wire import maybe_compress_reply
 
@@ -417,6 +455,9 @@ class PSServer(socketserver.ThreadingTCPServer):
             "role": "ps_server",
             "uptime_s": round(time.monotonic() - self._t0, 3),
             "tensors": len(self.store.names()),
+            "local_endpoints": (list(self.local_endpoints.kinds)
+                                if self.local_endpoints is not None
+                                else []),
             "metrics": get_registry().snapshot(),
         }
 
@@ -433,8 +474,13 @@ class PSServer(socketserver.ThreadingTCPServer):
         live client connection (clients see a reset, not a quiet stall).
         Used by chaos tests and the restart-supervision story — a plain
         ``shutdown()`` leaves per-connection threads serving, which no
-        real shard death does."""
+        real shard death does.  Local endpoints stop accepting but
+        their rendezvous FILES stay behind, exactly like a SIGKILLed
+        shard's would — the next bind (supervised restart) cleans them
+        up, and clients probing a dead rendezvous fall back to TCP."""
         self.shutdown()
+        if self.local_endpoints is not None:
+            self.local_endpoints.close(unlink=False)
         with self._conns_lock:
             conns, self._conns = set(self._conns), set()
         for c in conns:
@@ -442,6 +488,8 @@ class PSServer(socketserver.ThreadingTCPServer):
         self.server_close()
 
     def server_close(self):
+        if getattr(self, "local_endpoints", None) is not None:
+            self.local_endpoints.close()  # idempotent; kill() won
         if self.profiler is not None:
             self.profiler.close()
         super().server_close()
@@ -521,7 +569,7 @@ class RemoteStore:
     def __init__(self, addrs: List[str], use_hash: bool = False,
                  timeout: float = 30.0, retry_policy=None, counters=None,
                  heartbeat: Optional[float] = None, compression=None,
-                 wire_window: Optional[int] = None):
+                 wire_window: Optional[int] = None, transport=None):
         from ..common.config import get_config
         from ..common.context import ServerSharder
         from ..compression import (CompressionPolicy, WireCompressor,
@@ -534,6 +582,21 @@ class RemoteStore:
             raise ValueError("RemoteStore needs at least one server address")
         cfg = get_config()
         self._addrs = list(addrs)
+        # per-endpoint transport resolution (engine/transport.py):
+        # ``transport=`` (str spec, or {addr: spec} dict) beats
+        # BYTEPS_TRANSPORT_OVERRIDES beats BYTEPS_TRANSPORT.  ``auto``
+        # resolves ONCE here (probing the rendezvous), so every
+        # reconnect of a shard stays on the transport its first
+        # connection chose — failover must not flip transports mid-run.
+        per_addr = dict(transport) if isinstance(transport, dict) else {}
+        base_spec = (transport if isinstance(transport, str) and transport
+                     else cfg.transport)
+        env_over = parse_overrides(cfg.transport_overrides)
+        self._tspec = [
+            resolve_transport(a, per_addr.get(a, env_over.get(a, base_spec)))
+            for a in addrs
+        ]
+        self._transports = [k for k, _ in self._tspec]
         self._sharder = ServerSharder(len(addrs), use_hash=use_hash)
         self._socks: List[Optional[socket.socket]] = [None] * len(addrs)
         self._locks = [threading.Lock() for _ in addrs]
@@ -615,7 +678,8 @@ class RemoteStore:
                 ShardWorker(
                     (lambda i=i: self._connect(i)), self._window, shard=i,
                     recv_timeout=self._timeout,
-                    on_reset=(lambda err, n, i=i: self._on_wire_reset(i, n)))
+                    on_reset=(lambda err, n, i=i: self._on_wire_reset(i, n)),
+                    transport=self._transports[i])
                 for i in range(len(addrs))
             ]
         self._hb_interval = cfg.heartbeat_interval_ms / 1e3
@@ -629,11 +693,9 @@ class RemoteStore:
     # ------------------------------------------------ sockets & heartbeat
 
     def _connect(self, i: int) -> socket.socket:
-        host, port = self._addrs[i].rsplit(":", 1)
-        s = socket.create_connection((host, int(port)),
-                                     timeout=self._timeout)
-        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        return s
+        kind, path = self._tspec[i]
+        return transport_connect(kind, path, self._addrs[i],
+                                 timeout=self._timeout)
 
     def _sock(self, i: int) -> socket.socket:
         if self._socks[i] is None:
@@ -681,10 +743,12 @@ class RemoteStore:
             else:
                 yield tid
 
-    def _trace_part_spans(self, name: str, pending) -> None:
+    def _trace_part_spans(self, name: str, pending, shard: int = 0) -> None:
         """Emit the client-queue (submit->sent) and wire (sent->reply)
         spans of one acked frame from the stamps its ``PendingRpc``
-        noted — the I/O threads never touch the tracer."""
+        noted — the I/O threads never touch the tracer.  Wire spans
+        carry the shard's resolved transport, so a merged timeline
+        shows which frames rode the fast path."""
         if not self._trace_rpc:
             return
         tracer = get_tracer()
@@ -696,7 +760,8 @@ class RemoteStore:
                         trace_id=tid)
         if pending.t_reply:
             tracer.complete(name or "<frame>", "wire", pending.t_sent,
-                            pending.t_reply - pending.t_sent, trace_id=tid)
+                            pending.t_reply - pending.t_sent, trace_id=tid,
+                            transport=self._transports[shard])
 
     # -------------------------------------------------- part-level fan-out
 
@@ -919,7 +984,7 @@ class RemoteStore:
                                     trace_id=self._tid()),
                     priority=priority, key=key)
             status, rname, out, payload = worker.wait(pending, wait)
-            self._trace_part_spans(name, pending)
+            self._trace_part_spans(name, pending, shard)
         else:
             t0 = 0.0
             with self._locks[shard]:
@@ -944,7 +1009,8 @@ class RemoteStore:
                 if tracer.enabled:
                     tracer.complete(name or "<frame>", "wire", t0,
                                     time.perf_counter() - t0,
-                                    trace_id=self._tid().hex())
+                                    trace_id=self._tid().hex(),
+                                    transport=self._transports[shard])
         if status != 0:
             raise RuntimeError(f"ps_server error: {bytes(payload).decode()!r}")
         return rname, out, payload

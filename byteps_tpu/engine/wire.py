@@ -49,6 +49,7 @@ against.  See docs/wire.md.
 from __future__ import annotations
 
 import collections
+import os
 import socket
 import struct
 import threading
@@ -202,13 +203,24 @@ def _encode(op: int, name: str, arr, raw: bytes = b"") -> bytes:
         bytes(b) if not isinstance(b, bytes) else b for b in bufs)
 
 
+# sendmsg rejects iovecs longer than IOV_MAX (1024 on Linux) with
+# EMSGSIZE; chunking here means a high partition/buffer fan-out can
+# never hit it.  sysconf is authoritative where available.
+try:
+    _IOV_MAX = min(1024, os.sysconf("SC_IOV_MAX"))
+except (AttributeError, OSError, ValueError):  # pragma: no cover
+    _IOV_MAX = 1024
+
+
 def _send_buffers(sock: socket.socket, buffers: Sequence) -> None:
     """``sendall`` a list of buffers with ``sendmsg`` scatter-gather —
     the kernel walks the iovec, no user-space concatenation.  Handles
-    partial sends across buffer boundaries."""
+    partial sends across buffer boundaries, and caps each ``sendmsg``
+    at ``IOV_MAX`` buffers (beyond it the kernel fails with EMSGSIZE
+    rather than sending partially)."""
     views = [memoryview(b).cast("B") for b in buffers if len(b)]
     while views:
-        sent = sock.sendmsg(views)
+        sent = sock.sendmsg(views[:_IOV_MAX])
         while views and sent >= len(views[0]):
             sent -= len(views[0])
             views.pop(0)
@@ -336,14 +348,19 @@ class ShardWorker:
     reply would deadlock both socket buffers.
 
     ``connect`` is a zero-arg callable returning a fresh connected
-    socket (the RemoteStore supplies it so address/timeout policy stays
-    in one place).  ``on_reset(exc, n_inflight)`` fires once per
-    connection kill — the store bumps its reconnect/window counters
-    there."""
+    socket — or anything duck-typing its blocking stream surface
+    (engine/transport.py: the AF_UNIX and shared-memory-ring fast paths
+    plug in here, with the window/FIFO/abort contract untouched by
+    construction).  The RemoteStore supplies it so address/timeout/
+    transport policy stays in one place; ``transport`` is the resolved
+    transport kind, used only to label this shard's wire metrics.
+    ``on_reset(exc, n_inflight)`` fires once per connection kill — the
+    store bumps its reconnect/window counters there."""
 
     def __init__(self, connect: Callable[[], socket.socket], window: int,
                  shard: int = 0, recv_timeout: float = 30.0,
-                 on_reset: Optional[Callable] = None):
+                 on_reset: Optional[Callable] = None,
+                 transport: str = "tcp"):
         self._connect = connect
         self._window = max(1, int(window))
         self._shard = shard
@@ -368,15 +385,18 @@ class ShardWorker:
         # the I/O threads, per-frame trace detail already comes from the
         # client-queue/wire spans, and mirroring every bump measurably
         # taxes the step (bench_obs.py) — scrapes still see live values
+        # byte/frame/reply counters carry the transport label so a
+        # scrape can attribute wire volume to tcp vs the local fast
+        # paths per shard (docs/wire.md "Transports")
         self._m_bytes = reg.counter("wire.bytes_sent", track="wire",
                                     instants=False, mirror=False,
-                                    shard=shard)
+                                    shard=shard, transport=transport)
         self._m_frames = reg.counter("wire.frames_sent", track="wire",
                                      instants=False, mirror=False,
-                                     shard=shard)
+                                     shard=shard, transport=transport)
         self._m_replies = reg.counter("wire.replies_received", track="wire",
                                       instants=False, mirror=False,
-                                      shard=shard)
+                                      shard=shard, transport=transport)
         self._m_inflight = reg.gauge("wire.inflight", track="wire",
                                      mirror=False, shard=shard)
         self._m_qdepth = reg.gauge("wire.queue_depth", track="wire",

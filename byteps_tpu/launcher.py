@@ -32,6 +32,18 @@ The same env names keep working so reference run scripts port directly:
                                            recovery — docs/resilience.md)
   BYTEPS_SERVER_RESTART_BACKOFF_MS      -> pause between restarts
                                            (default 1000)
+  BYTEPS_TRANSPORT                      -> endpoint transports
+                                           (docs/wire.md "Transports"):
+                                           server/serve roles advertise
+                                           AF_UNIX + shared-memory
+                                           rendezvous next to their TCP
+                                           port unless set to "tcp";
+                                           colocated clients pick the
+                                           fast path under the default
+                                           "auto".  A supervised restart
+                                           rebinds over the crashed
+                                           shard's stale rendezvous
+                                           files automatically.
 
 Usage::
 

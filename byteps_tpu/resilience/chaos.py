@@ -38,6 +38,9 @@ import time
 from typing import List, Optional, Tuple, Union
 
 from ..common import logging as bps_log
+from ..engine.transport import (_cleanup_stale_uds, endpoint_path,
+                                maybe_nodelay, resolve_transport,
+                                transport_connect)
 # one wire framing, one reader: a protocol change in the PS tier must
 # break the proxy loudly at import/parse time, not silently diverge.
 # NB the proxy relays strictly one frame at a time per connection —
@@ -78,9 +81,20 @@ def _read_frame(sock: socket.socket) -> bytes:
 
 class FaultInjectingProxy:
     """One proxy instance fronts one PS shard; point ``RemoteStore`` at
-    ``proxy.addr`` instead of the real server address."""
+    ``proxy.addr`` instead of the real server address.
 
-    def __init__(self, target: str, seed: int = 0, host: str = "127.0.0.1"):
+    Transport-aware (docs/wire.md "Transports"): with
+    ``listen_local=True`` the proxy ALSO binds the UDS rendezvous a
+    real server on its TCP port would advertise, so a client resolving
+    ``proxy.addr`` with ``BYTEPS_TRANSPORT=unix``/``auto`` rides the
+    fast path *through the fault plan* — the chaos smoke proves the
+    exactly-once and failover contracts transport-independently.
+    ``upstream_transport`` picks how the proxy reaches the real shard
+    (``"unix"`` exercises the server's local endpoint end to end)."""
+
+    def __init__(self, target: str, seed: int = 0, host: str = "127.0.0.1",
+                 listen_local: bool = False,
+                 upstream_transport: str = "tcp"):
         self._target = target
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
@@ -95,6 +109,11 @@ class FaultInjectingProxy:
         self.requests_seen = 0
         self.faults_injected = 0
 
+        # upstream transport resolved once (the real shard must already
+        # be listening — chaos harnesses spawn servers first)
+        self._up_kind, self._up_path = resolve_transport(
+            target, upstream_transport)
+
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host, 0))
@@ -102,8 +121,22 @@ class FaultInjectingProxy:
         self._host = host
         self._port = self._listener.getsockname()[1]
         self._accept_thread = threading.Thread(
-            target=self._accept_loop, name="bps-chaos-accept", daemon=True)
+            target=self._accept_loop, args=(self._listener,),
+            name="bps-chaos-accept", daemon=True)
         self._accept_thread.start()
+        self._uds_listener = None
+        self.uds_path = None
+        if listen_local:
+            path = endpoint_path(self._port, "unix")
+            _cleanup_stale_uds(path)
+            uds = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            uds.bind(path)
+            uds.listen(16)
+            self._uds_listener = uds
+            self.uds_path = path
+            threading.Thread(target=self._accept_loop, args=(uds,),
+                             name="bps-chaos-accept-uds",
+                             daemon=True).start()
 
     # ------------------------------------------------------------------ knobs
 
@@ -141,19 +174,47 @@ class FaultInjectingProxy:
 
     def close(self) -> None:
         self._closed.set()
-        try:
-            self._listener.close()
-        except OSError:
-            pass
+        for lst in (self._listener, self._uds_listener):
+            if lst is None:
+                continue
+            # shutdown() first: a thread blocked in accept(2) holds the
+            # listener's file description past close() and could hand
+            # out one more connection
+            try:
+                lst.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                lst.close()
+            except OSError:
+                pass
+        if self.uds_path is not None:
+            from ..engine.transport import _kick_listener
+
+            # a thread blocked in accept(2) on the UDS listener holds
+            # it open past close() — kick it through the closed-guard
+            _kick_listener(self.uds_path)
+            try:
+                import os
+
+                os.unlink(self.uds_path)
+            except OSError:
+                pass
         self.blackhole(False)  # also resets lingering connections
 
     # ------------------------------------------------------------------ loops
 
-    def _accept_loop(self) -> None:
+    def _accept_loop(self, listener) -> None:
         while not self._closed.is_set():
             try:
-                client, _ = self._listener.accept()
+                client, _ = listener.accept()
             except OSError:
+                return
+            if self._closed.is_set():
+                try:
+                    client.close()
+                except OSError:
+                    pass
                 return
             with self._lock:
                 self._conns.append(client)
@@ -181,8 +242,7 @@ class FaultInjectingProxy:
         upstream: Optional[socket.socket] = None
         swallowing = False  # sticky: a hung stream answers NOTHING more
         try:
-            client.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            host, port = self._target.rsplit(":", 1)
+            maybe_nodelay(client)
             while not self._closed.is_set():
                 try:
                     frame = _read_frame(client)
@@ -212,10 +272,9 @@ class FaultInjectingProxy:
                     self.faults_injected += 1
                     time.sleep(float(fault[1]))
                 if upstream is None:
-                    upstream = socket.create_connection((host, int(port)),
-                                                        timeout=30.0)
-                    upstream.setsockopt(socket.IPPROTO_TCP,
-                                        socket.TCP_NODELAY, 1)
+                    upstream = transport_connect(
+                        self._up_kind, self._up_path, self._target,
+                        timeout=30.0)
                 upstream.sendall(frame)
                 reply = _read_frame(upstream)
                 if fault == "drop_after":

@@ -26,7 +26,6 @@ into one decode pool (that is the whole point of continuous batching).
 from __future__ import annotations
 
 import json
-import socket
 import socketserver
 import threading
 from typing import List, Optional
@@ -35,6 +34,8 @@ import numpy as np
 
 from ..common import logging as bps_log
 from ..engine.ps_server import _decode, _encode
+from ..engine.transport import (LocalEndpoints, maybe_nodelay,
+                                resolve_transport, transport_connect)
 from .engine import Request, ServingEngine
 from .scheduler import AdmissionError
 
@@ -89,7 +90,7 @@ class _ServeHandler(socketserver.BaseRequestHandler):
     def handle(self):  # one connection, many requests
         engine: ServingEngine = self.server.engine  # type: ignore
         sock = self.request
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        maybe_nodelay(sock)
         try:
             while True:
                 try:
@@ -145,9 +146,28 @@ class ServeFrontend(socketserver.ThreadingTCPServer):
     def __init__(self, addr, engine: ServingEngine):
         super().__init__(addr, _ServeHandler)
         self.engine = engine
+        # colocated fast path (docs/wire.md "Transports"): advertise a
+        # UDS + shm rendezvous next to the TCP port, served by the SAME
+        # handler over the same engine, unless pinned to TCP
+        self.local_endpoints = None
+        from ..common.config import get_config
+
+        if get_config().transport != "tcp":
+            try:
+                self.local_endpoints = LocalEndpoints(
+                    self.server_address[1], _ServeHandler, self)
+            except ValueError:
+                super().server_close()
+                raise
+            except OSError as e:
+                bps_log.warning(
+                    "serve frontend: local transport endpoints "
+                    "unavailable (%s); serving TCP only", e)
         engine.start()
 
     def server_close(self):
+        if self.local_endpoints is not None:
+            self.local_endpoints.close()
         self.engine.stop()
         super().server_close()
 
@@ -180,13 +200,19 @@ def serve(engine: ServingEngine, port: int, host: str = "0.0.0.0",
 
 
 class RemoteServeClient:
-    """Client for the TCP frontend (same framing as ``RemoteStore``)."""
+    """Client for the serve frontend (same framing as ``RemoteStore``).
+    ``transport`` is resolved per endpoint exactly like the PS
+    client's (``auto`` default: UDS/shm for a colocated frontend, TCP
+    otherwise — docs/wire.md "Transports")."""
 
-    def __init__(self, addr: str, timeout: float = 300.0):
-        host, port = addr.rsplit(":", 1)
-        self._sock = socket.create_connection((host, int(port)),
-                                              timeout=timeout)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    def __init__(self, addr: str, timeout: float = 300.0,
+                 transport: Optional[str] = None):
+        from ..common.config import get_config
+
+        kind, path = resolve_transport(
+            addr, transport if transport else get_config().transport)
+        self.transport = kind
+        self._sock = transport_connect(kind, path, addr, timeout=timeout)
         self._lock = threading.Lock()
 
     def _rpc(self, op: int, name: str = "", arr=None):

@@ -26,10 +26,22 @@ un-acked in-flight window of partition frames must neither drop nor
 double-apply any part, and partition EF commits must stay exactly-once
 in any completion order.
 
+With ``--transport unix`` the same bar runs on the AF_UNIX fast path
+(docs/wire.md "Transports"): the proxies bind the UDS rendezvous a real
+shard would advertise AND reach the shards over their UDS endpoints, so
+every faulted frame rides AF_UNIX end to end — proving the exactly-once
+and failover contracts are transport-independent.
+
+With ``--kill-shard-at N`` the chaos run additionally hard-kills shard 1
+(server + proxy) after step N, so failover *deterministically* fires and
+the remaining steps run degraded — the clean run has no kill, so the
+bit-for-bit verdict also proves failover re-seeding loses nothing.
+
 Usage:
     python scripts/chaos_smoke.py [--steps 60] [--seed 0] [--rate 0.15]
                                   [--compression randomk] [--window 8]
                                   [--partition-bytes 64]
+                                  [--transport unix] [--kill-shard-at 30]
 
 Wired into CI as ``slow``-marked pytests (tests/test_chaos_smoke.py —
 the compressed variant runs at a >=25% injected fault rate) so tier-1
@@ -50,7 +62,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 def run(steps: int = 60, seed: int = 0, rate: float = 0.15,
         dim: int = 16, verbose: bool = True,
         compression: str = "", window: int = None,
-        partition_bytes: int = None) -> dict:
+        partition_bytes: int = None, transport: str = None,
+        kill_shard_at: int = None) -> dict:
     import dataclasses
 
     from byteps_tpu.common.config import get_config, set_config
@@ -70,6 +83,7 @@ def run(steps: int = 60, seed: int = 0, rate: float = 0.15,
                                        partition_align=8))
     try:
         return _run(steps, seed, rate, dim, verbose, compression, window,
+                    transport, kill_shard_at,
                     ps_server, CompressionPolicy, FaultInjectingProxy,
                     ResilienceCounters, RetryPolicy)
     finally:
@@ -77,6 +91,7 @@ def run(steps: int = 60, seed: int = 0, rate: float = 0.15,
 
 
 def _run(steps, seed, rate, dim, verbose, compression, window,
+         transport, kill_shard_at,
          ps_server, CompressionPolicy, FaultInjectingProxy,
          ResilienceCounters, RetryPolicy) -> dict:
     names = ["w", "b", "c0", "c1"]
@@ -90,11 +105,13 @@ def _run(steps, seed, rate, dim, verbose, compression, window,
                               seed=seed)
             if compression else None)
 
-    def train(store):
+    def train(store, on_step=None):
         state = {n: np.zeros(dim, np.float32) for n in names}
         for n in names:
             store.init_tensor(n, state[n])
-        for _ in range(steps):
+        for s in range(steps):
+            if on_step is not None:
+                on_step(s)
             for n in names:
                 delta = 0.1 * (target[n] - state[n])
                 state[n] = store.push_pull(n, delta.astype(np.float32))
@@ -105,11 +122,23 @@ def _run(steps, seed, rate, dim, verbose, compression, window,
                                  in_thread=True)
         return srv, f"127.0.0.1:{srv.server_address[1]}"
 
+    # the fast-path leg: proxies advertise the UDS rendezvous a real
+    # shard would AND reach the shards over their UDS endpoints, so
+    # every faulted frame rides AF_UNIX end to end.  shm is refused
+    # upfront: the frame-relaying proxy has no shm listener, and the
+    # connect failures it would cause read like a resilience bug
+    if transport not in (None, "tcp", "unix"):
+        raise ValueError(
+            f"chaos smoke supports --transport tcp|unix, not "
+            f"{transport!r} (the fault proxy relays stream frames; "
+            f"shm rings have no frame boundary to intercept)")
+    local = bool(transport and transport != "tcp")
+
     # ---- clean run -----------------------------------------------------
     servers = [spawn() for _ in range(2)]
     store = ps_server.RemoteStore([a for _, a in servers],
                                   retry_policy=policy, compression=comp,
-                                  wire_window=window)
+                                  wire_window=window, transport=transport)
     clean = train(store)
     store.close()
     for srv, _ in servers:
@@ -117,7 +146,8 @@ def _run(steps, seed, rate, dim, verbose, compression, window,
 
     # ---- chaos run -----------------------------------------------------
     servers = [spawn() for _ in range(2)]
-    proxies = [FaultInjectingProxy(a, seed=seed + i)
+    proxies = [FaultInjectingProxy(a, seed=seed + i, listen_local=local,
+                                   upstream_transport=transport or "tcp")
                for i, (_, a) in enumerate(servers)]
     for p in proxies:
         # drop_after is the nasty one (applied + reply lost); keep some
@@ -127,8 +157,18 @@ def _run(steps, seed, rate, dim, verbose, compression, window,
     counters = ResilienceCounters()
     store = ps_server.RemoteStore([p.addr for p in proxies],
                                   retry_policy=policy, counters=counters,
-                                  compression=comp, wire_window=window)
-    chaos = train(store)
+                                  compression=comp, wire_window=window,
+                                  transport=transport)
+
+    def on_step(s):
+        # deterministic mid-run shard death: failover MUST fire, and the
+        # bit-for-bit verdict below proves its re-seed lost nothing (the
+        # clean run never sees the kill — pure-math state evolution)
+        if kill_shard_at is not None and s == kill_shard_at:
+            servers[1][0].kill()
+            proxies[1].close()
+
+    chaos = train(store, on_step=on_step)
     stats = {
         "requests": sum(p.requests_seen for p in proxies),
         "faults": sum(p.faults_injected for p in proxies),
@@ -138,7 +178,10 @@ def _run(steps, seed, rate, dim, verbose, compression, window,
     for p in proxies:
         p.close()
     for srv, _ in servers:
-        srv.shutdown(); srv.server_close()
+        try:
+            srv.shutdown(); srv.server_close()
+        except OSError:  # the killed shard is already down
+            pass
 
     # ---- verdict -------------------------------------------------------
     for n in names:
@@ -150,8 +193,14 @@ def _run(steps, seed, rate, dim, verbose, compression, window,
         raise AssertionError(
             "no faults were injected — raise --rate or --steps, the run "
             "proved nothing")
+    if kill_shard_at is not None and not stats.get("resilience.failover"):
+        raise AssertionError(
+            "shard 1 was killed but failover never fired — the run "
+            "proved nothing about degraded mode")
     if verbose:
         mode = f" [compression={compression}]" if compression else ""
+        if transport:
+            mode += f" [transport={transport}]"
         print(f"chaos smoke OK{mode}: {steps} steps x {len(names)} "
               f"tensors, {stats['faults']}/{stats['requests']} requests "
               f"faulted, bit-for-bit parameter match")
@@ -175,11 +224,19 @@ def main() -> int:
                     help="split tensors into wire partitions of this "
                          "size (exercises the mid-window multi-part "
                          "fault paths)")
+    ap.add_argument("--transport", type=str, default=None,
+                    help="endpoint transport for the whole run (e.g. "
+                         "'unix' proves the fast path end to end; "
+                         "default: BYTEPS_TRANSPORT resolution)")
+    ap.add_argument("--kill-shard-at", type=int, default=None,
+                    help="hard-kill shard 1 after this chaos step so "
+                         "failover deterministically fires")
     ap.add_argument("--dim", type=int, default=16)
     args = ap.parse_args()
     run(steps=args.steps, seed=args.seed, rate=args.rate,
         compression=args.compression, window=args.window,
-        partition_bytes=args.partition_bytes, dim=args.dim)
+        partition_bytes=args.partition_bytes, dim=args.dim,
+        transport=args.transport, kill_shard_at=args.kill_shard_at)
     return 0
 
 

@@ -48,6 +48,26 @@ def test_chaos_smoke_compressed_exactly_once(scheme):
 
 
 @pytest.mark.slow
+def test_chaos_smoke_uds_transport_exactly_once_with_failover():
+    """PR 7 acceptance (docs/wire.md "Transports"): the full chaos bar
+    on the AF_UNIX fast path — pipelined window, partitioned tensors,
+    compression + EF, faults injected on every UDS connection, AND a
+    deterministic mid-run shard kill so failover provably fires.  The
+    clean run never sees the kill, so bit-for-bit parity additionally
+    proves the failover re-seed loses nothing on this transport."""
+    import chaos_smoke
+
+    stats = chaos_smoke.run(steps=40, seed=1, rate=0.27, verbose=False,
+                            compression="randomk", window=8,
+                            partition_bytes=24, dim=64,
+                            transport="unix", kill_shard_at=30)
+    assert stats["faults"] > 0
+    assert stats.get("resilience.window_abort", 0) > 0
+    assert stats.get("resilience.retry_dedup", 0) > 0
+    assert stats.get("resilience.failover", 0) >= 1
+
+
+@pytest.mark.slow
 def test_chaos_smoke_pipelined_partitioned_exactly_once():
     """PR 4 acceptance (docs/wire.md): the pipelined wire client —
     in-flight window, partitioned tensors fanned out across shards,

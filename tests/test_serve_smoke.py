@@ -154,6 +154,13 @@ def test_tcp_frontend_roundtrip_and_backpressure():
         stats = c.stats()
         assert stats["serve.requests_completed"] == 3
         assert stats["compile_counts"]["decode"] == 1
+        # the frontend advertises the colocated fast path (docs/wire.md
+        # "Transports"): an auto-resolved client rides UDS into the
+        # SAME engine with exact parity
+        cu = RemoteServeClient(addr, transport="unix")
+        assert cu.transport == "unix"
+        np.testing.assert_array_equal(cu.generate(prompts[0], M), base[0])
+        cu.close()
         # typed backpressure over the wire: stall admissions (stop the
         # tick thread), fill the queue, and the reply is a status=1
         # QueueFullError message on a connection that stays usable

@@ -1,0 +1,416 @@
+"""Endpoint transports (byteps_tpu/engine/transport.py, docs/wire.md
+"Transports"): SPSC ring mechanics, shm connection stream semantics
+(partial reads/writes, timeout, EOF), rendezvous path rules (UDS length
+limit, stale-socket cleanup, live-collision loudness), auto selection
+(local fast path vs TCP — an acceptance criterion), and end-to-end
+push_pull bit-parity + retry/exactly-once on the fast paths."""
+
+import os
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from byteps_tpu.common.config import Config, reset_config, set_config
+from byteps_tpu.common.context import ServerSharder, name_key
+from byteps_tpu.engine import ps_server
+from byteps_tpu.engine import transport as tp
+from byteps_tpu.resilience import (FaultInjectingProxy, ResilienceCounters,
+                                   RetryPolicy, reset_counters)
+from byteps_tpu.resilience import counters as cn
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    reset_config()
+    reset_counters()
+    yield
+    reset_config()
+    reset_counters()
+
+
+def _fast_policy(**kw):
+    kw.setdefault("max_attempts", 6)
+    kw.setdefault("backoff_base", 0.01)
+    kw.setdefault("jitter", 0.0)
+    kw.setdefault("deadline", 20.0)
+    return RetryPolicy(**kw)
+
+
+def _spawn(n=1):
+    out = []
+    for _ in range(n):
+        srv, _ = ps_server.serve(0, host="127.0.0.1", use_native=False,
+                                 in_thread=True)
+        out.append((srv, f"127.0.0.1:{srv.server_address[1]}"))
+    return out
+
+
+def _stop(servers):
+    for srv, _ in servers:
+        srv.shutdown()
+        srv.server_close()
+
+
+# ------------------------------------------------------------- ring unit
+
+
+def test_ring_write_read_wraparound():
+    cap = 16
+    buf = memoryview(bytearray(tp._RING_HDR + cap))
+    ring = tp._Ring(buf, 0, cap)
+    out = bytearray(64)
+    # fill, drain partially, refill across the wrap boundary
+    assert ring.write(memoryview(b"abcdefgh")) == 8
+    assert ring.write(memoryview(b"ijklmnopQRS")) == 8  # only space for 8
+    assert ring.read_into(memoryview(out)[:10]) == 10
+    assert bytes(out[:10]) == b"abcdefghij"
+    assert ring.write(memoryview(b"0123456789XY")) == 10  # wraps
+    assert ring.read_into(memoryview(out)) == 16
+    assert bytes(out[:16]) == b"klmnop0123456789"
+    assert ring.read_into(memoryview(out)) == 0  # empty
+    assert ring.empty()
+    # closed flags are per-side
+    ring.close_writer()
+    assert ring.writer_closed() and not ring.reader_closed()
+
+
+def test_ring_chunk_cap_publishes_incrementally(monkeypatch):
+    monkeypatch.setattr(tp._Ring, "_CHUNK", 4)
+    cap = 64
+    buf = memoryview(bytearray(tp._RING_HDR + cap))
+    ring = tp._Ring(buf, 0, cap)
+    # a single call moves at most _CHUNK so the peer sees progress
+    # (and can start draining) before a large transfer completes
+    assert ring.write(memoryview(b"x" * 40)) == 4
+    out = bytearray(40)
+    assert ring.read_into(memoryview(out)) == 4
+
+
+def _shm_pair(tmp_path, monkeypatch, ring_mb=0):
+    """A connected (client, server) ShmConnection pair through a real
+    rendezvous handshake (ring_mb=0 -> the 64 KiB floor, so tests
+    stream through a deliberately tiny ring)."""
+    monkeypatch.setenv("BYTEPS_TRANSPORT_DIR", str(tmp_path))
+    monkeypatch.setenv("BYTEPS_TRANSPORT_SHM_MB", str(ring_mb))
+    reset_config()
+    path = str(tmp_path / "hs.shm")
+    lst = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    lst.bind(path)
+    lst.listen(1)
+    result = {}
+
+    def _accept():
+        conn, _ = lst.accept()
+        result["server"] = tp._accept_shm(conn)
+
+    t = threading.Thread(target=_accept, daemon=True)
+    t.start()
+    client = tp._connect_shm(path, "t:0", timeout=5.0)
+    t.join(timeout=5.0)
+    lst.close()
+    return client, result["server"]
+
+
+def test_shm_connection_streams_through_tiny_ring(tmp_path, monkeypatch):
+    client, server = _shm_pair(tmp_path, monkeypatch)  # 64 KiB rings
+    payload = np.random.default_rng(0).integers(
+        0, 256, 1 << 20, dtype=np.uint8).tobytes()  # 1 MiB >> ring
+
+    def _pump():
+        got = bytearray(len(payload))
+        view, n = memoryview(got), 0
+        while n < len(payload):
+            r = server.recv_into(view[n:])
+            assert r > 0
+            n += r
+        server.sendall(bytes(got[::-1]))  # echo reversed
+
+    t = threading.Thread(target=_pump, daemon=True)
+    t.start()
+    client.sendall(payload)
+    back = bytearray(len(payload))
+    view, n = memoryview(back), 0
+    client.settimeout(10.0)
+    while n < len(back):
+        r = client.recv_into(view[n:])
+        assert r > 0
+        n += r
+    t.join(timeout=10.0)
+    assert bytes(back) == payload[::-1]
+    client.close()
+    server.close()
+
+
+def test_shm_recv_timeout_then_eof(tmp_path, monkeypatch):
+    client, server = _shm_pair(tmp_path, monkeypatch)
+    client.settimeout(0.2)
+    buf = bytearray(8)
+    t0 = time.monotonic()
+    with pytest.raises(socket.timeout):
+        client.recv_into(memoryview(buf))
+    assert 0.1 < time.monotonic() - t0 < 2.0
+    # a graceful peer close is a clean EOF (0), like a FIN
+    server.close()
+    assert client.recv_into(memoryview(buf)) == 0
+    # and sending into a closed peer raises the pipe error family
+    with pytest.raises(OSError):
+        client.sendall(b"x" * (1 << 20))
+    client.close()
+
+
+def test_shm_handshake_rejects_garbage(tmp_path, monkeypatch):
+    monkeypatch.setenv("BYTEPS_TRANSPORT_DIR", str(tmp_path))
+    reset_config()
+    path = str(tmp_path / "bad.shm")
+    lst = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    lst.bind(path)
+    lst.listen(1)
+    errs = {}
+
+    def _accept():
+        conn, _ = lst.accept()
+        try:
+            tp._accept_shm(conn)
+        except ConnectionError as e:
+            errs["e"] = e
+        finally:
+            conn.close()
+
+    t = threading.Thread(target=_accept, daemon=True)
+    t.start()
+    c = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    c.connect(path)
+    c.sendall(b"NOTAHANDSHAKE!!!!!!!!!")
+    t.join(timeout=5.0)
+    c.close()
+    lst.close()
+    assert "e" in errs  # loud, never a guessed layout
+
+
+# ------------------------------------------------------ rendezvous rules
+
+
+def test_endpoint_path_too_long_fails_loudly(tmp_path, monkeypatch):
+    deep = tmp_path / ("d" * 120)
+    deep.mkdir()
+    monkeypatch.setenv("BYTEPS_TRANSPORT_DIR", str(deep))
+    reset_config()
+    with pytest.raises(ValueError) as ei:
+        tp.endpoint_path(12345, "unix")
+    assert str(deep) in str(ei.value)  # names the offending path
+    assert "BYTEPS_TRANSPORT_DIR" in str(ei.value)
+
+
+def test_stale_socket_cleanup_and_live_collision(tmp_path, monkeypatch):
+    monkeypatch.setenv("BYTEPS_TRANSPORT_DIR", str(tmp_path))
+    reset_config()
+    path = tp.endpoint_path(4242, "unix")
+    # stale: a bound-then-closed socket leaves its file behind
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.bind(path)
+    s.close()
+    assert os.path.exists(path)
+    tp._cleanup_stale_uds(path)
+    assert not os.path.exists(path)
+    # live: a listening server on the path must NOT be unlinked
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.bind(path)
+    s.listen(1)
+    with pytest.raises(OSError):
+        tp._cleanup_stale_uds(path)
+    assert os.path.exists(path)
+    s.close()
+
+
+def test_server_rebinds_over_stale_rendezvous_after_kill():
+    """kill() leaves rendezvous files behind (a crashed shard would);
+    a supervised restart on the same port must clean and rebind, and a
+    fresh auto client must reach it over the fast path."""
+    servers = _spawn(1)
+    srv, addr = servers[0]
+    port = srv.server_address[1]
+    upath = tp.endpoint_path(port, "unix")
+    srv.kill()
+    assert os.path.exists(upath)  # the corpse
+    srv2, _ = ps_server.serve(port, host="127.0.0.1", use_native=False,
+                              in_thread=True)
+    try:
+        st = ps_server.RemoteStore([addr])
+        assert st._transports == ["unix"]
+        st.init_tensor("r", np.ones(4, np.float32))
+        np.testing.assert_array_equal(st.pull("r"), np.ones(4, np.float32))
+        st.close()
+    finally:
+        srv2.shutdown()
+        srv2.server_close()
+    assert not os.path.exists(upath)
+
+
+# -------------------------------------------------------- auto selection
+
+
+def test_auto_selection_local_vs_remote(tmp_path, monkeypatch):
+    """Acceptance: ``auto`` picks the local transport for loopback
+    endpoints that advertise one, and TCP for non-local ones."""
+    monkeypatch.setenv("BYTEPS_TRANSPORT_DIR", str(tmp_path))
+    reset_config()
+    port = 45167
+    path = tp.endpoint_path(port, "unix")
+    lst = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    lst.bind(path)
+    lst.listen(1)
+    try:
+        # local + advertised -> the fast path
+        assert tp.resolve_transport(f"127.0.0.1:{port}", "auto") == \
+            ("unix", path)
+        assert tp.resolve_transport(f"localhost:{port}", "auto") == \
+            ("unix", path)
+        # NON-local host, same port: a rendezvous file proves nothing
+        # about a remote machine -> TCP
+        assert tp.resolve_transport(f"10.255.1.2:{port}", "auto") == \
+            ("tcp", None)
+        # local but nothing advertised -> TCP
+        assert tp.resolve_transport(f"127.0.0.1:{port + 1}", "auto") == \
+            ("tcp", None)
+    finally:
+        lst.close()
+    # a STALE rendezvous (listener gone, file left by a crash) must
+    # fall back to TCP, not wedge the client on a dead path
+    assert os.path.exists(path)
+    assert tp.resolve_transport(f"127.0.0.1:{port}", "auto") == \
+        ("tcp", None)
+    # explicit specs resolve without probing
+    assert tp.resolve_transport(f"127.0.0.1:{port}", "tcp") == ("tcp", None)
+    assert tp.resolve_transport(f"127.0.0.1:{port}", "unix") == \
+        ("unix", path)
+    assert tp.resolve_transport("x:1", "unix:/run/x.sock") == \
+        ("unix", "/run/x.sock")
+    with pytest.raises(ValueError):
+        tp.resolve_transport("x:1", "carrier-pigeon")
+
+
+def test_transport_overrides_parsing():
+    assert tp.parse_overrides("") == {}
+    assert tp.parse_overrides("10.0.0.2:7000=tcp, 127.0.0.1:7000=unix") == \
+        {"10.0.0.2:7000": "tcp", "127.0.0.1:7000": "unix"}
+    assert tp.parse_overrides("h:1=unix:/run/a.sock") == \
+        {"h:1": "unix:/run/a.sock"}
+    with pytest.raises(ValueError):
+        tp.parse_overrides("just-an-addr")
+
+
+def test_remote_store_per_endpoint_override(monkeypatch):
+    """One store, two shards, different transports per endpoint —
+    the ps-lite-van-style pluggability the refactor exists for."""
+    servers = _spawn(2)
+    addrs = [a for _, a in servers]
+    try:
+        st = ps_server.RemoteStore(
+            addrs, transport={addrs[0]: "unix", addrs[1]: "tcp"})
+        assert st._transports == ["unix", "tcp"]
+        st.close()
+        monkeypatch.setenv(
+            "BYTEPS_TRANSPORT_OVERRIDES", f"{addrs[1]}=shm")
+        reset_config()
+        st = ps_server.RemoteStore(addrs, transport="tcp")
+        # explicit per-endpoint env override beats the blanket spec
+        assert st._transports == ["tcp", "shm"]
+        st.close()
+    finally:
+        _stop(servers)
+
+
+# ----------------------------------------------------- end-to-end parity
+
+
+def test_push_pull_parity_across_transports():
+    """Acceptance: multi-part push_pull results are bit-identical
+    across tcp/unix/shm (vs the serial TCP client), and every store
+    sees the same version counters."""
+    set_config(Config(partition_bytes=64, partition_align=8))
+    servers = _spawn(1)
+    addr = servers[0][1]
+    try:
+        rng = np.random.default_rng(7)
+        x = rng.standard_normal(200).astype(np.float32)  # 800B -> 13 parts
+        stores = {
+            "serial": ps_server.RemoteStore([addr], wire_window=0,
+                                            transport="tcp"),
+            "tcp": ps_server.RemoteStore([addr], transport="tcp"),
+            "unix": ps_server.RemoteStore([addr], transport="unix"),
+            "shm": ps_server.RemoteStore([addr], transport="shm"),
+        }
+        for name, st in stores.items():
+            st.init_tensor(name, np.zeros_like(x))
+        for step in range(3):
+            outs = {n: st.push_pull(n, x * (step + 1))
+                    for n, st in stores.items()}
+            base = outs["serial"].tobytes()
+            for n, o in outs.items():
+                assert o.tobytes() == base, f"{n} diverged at step {step}"
+        for n, st in stores.items():
+            assert st.pull(n).tobytes() == stores["serial"].pull(
+                "serial").tobytes()
+            assert st.version(n) == 3
+            st.close()
+    finally:
+        _stop(servers)
+
+
+def test_uds_connection_reset_retry_exactly_once():
+    """Satellite: the version-guarded exactly-once retry contract on
+    the UDS path — a drop_after (applied, reply lost, connection
+    reset) must dedup, not double-apply, with every frame riding
+    AF_UNIX through the fault proxy to the shard's UDS endpoint."""
+    servers = _spawn(1)
+    addr = servers[0][1]
+    proxy = FaultInjectingProxy(addr, seed=0, listen_local=True,
+                                upstream_transport="unix")
+    counters = ResilienceCounters()
+    st = ps_server.RemoteStore([proxy.addr], transport="unix",
+                               retry_policy=_fast_policy(),
+                               counters=counters)
+    try:
+        assert st._transports == ["unix"]
+        st.init_tensor("w", np.zeros(4, np.float32))
+        st.push_pull("w", np.ones(4, np.float32))         # state 1
+        proxy.script("drop_after")
+        out = st.push_pull("w", 2 * np.ones(4, np.float32))  # state 3
+        np.testing.assert_allclose(out, 3.0)
+        assert counters.get(cn.DEDUP) == 1
+        proxy.script("drop_before")
+        out = st.push_pull("w", np.ones(4, np.float32))      # state 4
+        np.testing.assert_allclose(out, 4.0)
+        assert counters.get(cn.RETRY) >= 2
+        np.testing.assert_allclose(st.pull("w"), 4.0)
+    finally:
+        st.close()
+        proxy.close()
+        _stop(servers)
+
+
+def test_auto_picks_unix_end_to_end():
+    """Default config (BYTEPS_TRANSPORT=auto) against a live loopback
+    shard rides UDS without any caller opt-in — the whole point of the
+    colocated fast path."""
+    servers = _spawn(1)
+    addr = servers[0][1]
+    try:
+        st = ps_server.RemoteStore([addr])
+        assert st._transports == ["unix"]
+        st.init_tensor("a", np.arange(8, dtype=np.float32))
+        np.testing.assert_array_equal(
+            st.pull("a"), np.arange(8, dtype=np.float32))
+        stats = st.shard_stats(0)
+        assert sorted(stats["local_endpoints"]) == ["shm", "unix"]
+        # the server accounted the RPCs under the unix transport label
+        reqs = {k: v for k, v in stats["metrics"]["counters"].items()
+                if k.startswith("ps.requests_by_transport")}
+        assert reqs.get("ps.requests_by_transport{transport=unix}", 0) >= 2
+        st.close()
+    finally:
+        _stop(servers)

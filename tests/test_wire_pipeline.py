@@ -112,6 +112,32 @@ def test_send_buffers_partial_sends():
         b.close()
 
 
+def test_send_buffers_chunks_at_iov_max():
+    """Satellite regression: a frame of more than IOV_MAX (1024)
+    buffers must go out chunked rather than raise EMSGSIZE from
+    sendmsg — high partition/compression fan-out can't break the
+    wire."""
+    n = wire_mod._IOV_MAX * 2 + 37  # > two sendmsg batches
+    bufs = [bytes([i % 251]) * 3 for i in range(n)]
+    want = b"".join(bufs)
+    a, b = socket.socketpair()
+    try:
+        done = []
+
+        def _send():
+            _send_buffers(a, bufs)
+            done.append(True)
+
+        t = threading.Thread(target=_send, daemon=True)
+        t.start()
+        got = _recv_exact(b, len(want))
+        t.join(timeout=10.0)
+        assert done and bytes(got) == want
+    finally:
+        a.close()
+        b.close()
+
+
 def test_recv_exact_is_single_buffer():
     a, b = socket.socketpair()
     try:
@@ -320,18 +346,22 @@ def test_shard_worker_reset_fails_whole_window():
 # ----------------------------------------- RemoteStore pipelined semantics
 
 
-def test_pipelined_bit_identical_to_serial_multi_shard():
+@pytest.mark.parametrize("transport", ["tcp", "unix"])
+def test_pipelined_bit_identical_to_serial_multi_shard(transport):
     """Tentpole acceptance: with the window >1 and multi-part tensors
     over 4 shards, push_pull results are bit-identical to the serial
-    client's."""
+    client's — on the TCP and AF_UNIX transports alike (shm parity is
+    pinned in test_transport.py)."""
     set_config(Config(partition_bytes=64, partition_align=8))
     servers = _spawn(4)
     addrs = [a for _, a in servers]
     try:
         rng = np.random.default_rng(0)
         x = rng.standard_normal(200).astype(np.float32)  # 800B -> 13 parts
-        serial = ps_server.RemoteStore(addrs, wire_window=0)
-        piped = ps_server.RemoteStore(addrs, wire_window=8)
+        serial = ps_server.RemoteStore(addrs, wire_window=0,
+                                       transport=transport)
+        piped = ps_server.RemoteStore(addrs, wire_window=8,
+                                      transport=transport)
         serial.init_tensor("s", np.zeros_like(x))
         piped.init_tensor("p", np.zeros_like(x))
         for step in range(3):
@@ -346,7 +376,13 @@ def test_pipelined_bit_identical_to_serial_multi_shard():
         _stop(servers)
 
 
-def test_pipelined_compressed_out_of_order_part_completion():
+@pytest.mark.parametrize("transport", [
+    "tcp",
+    # one fast representative per transport is enough for tier-1; the
+    # unix leg of the matrix is slow-marked (CI budget satellite)
+    pytest.param("unix", marks=pytest.mark.slow),
+])
+def test_pipelined_compressed_out_of_order_part_completion(transport):
     """Partition EF commits stay exactly-once and bit-exact when parts
     COMPLETE out of order (a delayed shard): two pipelined steps match
     the serial client's two steps bit for bit, residuals included."""
@@ -362,12 +398,16 @@ def test_pipelined_compressed_out_of_order_part_completion():
 
     def run(window, delay):
         servers = _spawn(2)
-        proxies = [FaultInjectingProxy(a, seed=0) for _, a in servers]
+        local = transport != "tcp"
+        proxies = [FaultInjectingProxy(a, seed=0, listen_local=local,
+                                       upstream_transport=transport)
+                   for _, a in servers]
         comp = CompressionPolicy(default="randomk", min_bytes=1, ratio=0.5,
                                  seed=11)
         st = ps_server.RemoteStore([p.addr for p in proxies],
                                    retry_policy=_fast_policy(),
-                                   compression=comp, wire_window=window)
+                                   compression=comp, wire_window=window,
+                                   transport=transport)
         st.init_tensor(name, np.zeros_like(x))
         if delay:
             # parts 0-3's shard lags: parts 4-7 complete first
