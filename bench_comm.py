@@ -25,7 +25,12 @@ single-chip bench.py cannot:
   * **endpoint transports** (docs/wire.md "Transports") — same-host
     tcp vs unix vs shm A/B on single-frame ``pull``/``push_pull``
     round trips against one real shard process (``--transports-only``
-    runs just this; ``--wire-only`` runs both wire benches).
+    runs just this; ``--wire-only`` runs the wire benches);
+  * **hierarchical push/pull** (docs/wire.md "Hierarchical reduction")
+    — on-vs-off A/B of the local-mesh reduce-scatter stage: 4 emulated
+    colocated workers against real shard processes on the 5 ms wire;
+    measured mutation wire bytes/step must drop by ~local_size
+    (``--hierarchical`` runs just this).
 
 Prints ONE JSON line per point.  Runs anywhere (CPU virtual mesh by
 construction):  python bench_comm.py [--layers 8 --dim 1024]
@@ -528,6 +533,137 @@ def transport_ab(mb=1, reps=24, archive=True):
     return rows
 
 
+def hierarchical_ab(workers=4, mb=2, delay_ms=5.0, steps=3, shards=2,
+                    reps=3, archive=True):
+    """Hierarchical on-vs-off A/B on the emulated local mesh
+    (docs/wire.md "Hierarchical reduction"): ``workers`` colocated
+    workers — a ``dp`` submesh over the virtual CPU devices — exchange
+    an ``mb``-MiB gradient with real PS shard processes behind an
+    emulated ``delay_ms``/hop wire.
+
+      * OFF: every worker push_pulls its full dense gradient (the
+        pre-hierarchical eager PS path) — mutation wire bytes/step =
+        ``workers x tensor``;
+      * ON: a jitted ``psum_scatter`` reduces the workers' gradients
+        on-mesh first and only per-rank ``name@s{r}`` slices ride the
+        wire — ``1 x tensor``/step.
+
+    Wire bytes come from the ``compression.wire_bytes_sent`` counters
+    (client-side mutation payload accounting — transport-independent);
+    wall time is min-of-reps over interleaved legs.  Acceptance
+    (ISSUE 8): byte reduction >= 0.9 x ``workers``."""
+    import dataclasses
+    import subprocess
+    import sys as _sys
+
+    from byteps_tpu.common.config import get_config, set_config
+    from byteps_tpu.compression import (get_compression_stats,
+                                        reset_compression_stats)
+    from byteps_tpu.engine import hierarchical as hier
+    from byteps_tpu.engine import ps_server
+    from byteps_tpu.resilience import FaultInjectingProxy
+
+    mesh = Mesh(np.array(jax.devices()[:workers]), axis_names=("dp",))
+    ports = [_free_port() for _ in range(shards)]
+    procs, proxies, rows = [], [], []
+    saved_cfg = get_config()
+    try:
+        for p in ports:
+            procs.append(subprocess.Popen(
+                [_sys.executable, "-c",
+                 f"from byteps_tpu.engine import ps_server; "
+                 f"ps_server.serve({p}, host='127.0.0.1', "
+                 f"use_native=False)"],
+                env={**os.environ, "JAX_PLATFORMS": "cpu"}))
+        for p in ports:
+            _wait_port(p)
+        set_config(dataclasses.replace(saved_cfg, hierarchical=False))
+        proxies = [FaultInjectingProxy(f"127.0.0.1:{p}", seed=i)
+                   for i, p in enumerate(ports)]
+        for px in proxies:
+            px.set_rates(delay=delay_ms / 1e3)
+        addrs = [px.addr for px in proxies]
+        elems = mb * 1024 * 1024 // 4
+        grads = np.stack([np.full(elems, 0.01 * (w + 1), np.float32)
+                          for w in range(workers)])
+        # NB: the legs close over ``stats``, bound below after
+        # reset_compression_stats()
+
+        def leg_off(store, rep):
+            name = f"hier_off_{rep}"
+            store.init_tensor(name, np.zeros(elems, np.float32))
+            b0 = stats.summary()["wire_bytes_sent"]
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                for w in range(workers):  # every worker: full tensor
+                    store.push_pull(name, grads[w])
+            dt = (time.perf_counter() - t0) / steps
+            return stats.summary()["wire_bytes_sent"] - b0, dt
+
+        def leg_on(store, rep):
+            name = f"hier_on_{rep}"
+            # warm the scatter/gather traces before the timed window
+            hier.hierarchical_push_pull(store, name, grads, mesh,
+                                        min_bytes=1)
+            b0 = stats.summary()["wire_bytes_sent"]
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                hier.hierarchical_push_pull(store, name, grads, mesh,
+                                            min_bytes=1)
+            dt = (time.perf_counter() - t0) / steps
+            return stats.summary()["wire_bytes_sent"] - b0, dt
+
+        reset_compression_stats()
+        stats = get_compression_stats()
+        store = ps_server.RemoteStore(addrs, transport="tcp")
+        off_b = on_b = 0
+        off_t, on_t = [], []
+        for rep in range(reps):  # interleaved: ambient load hits both
+            b, t = leg_off(store, rep)
+            off_b = b  # bytes are deterministic per leg; keep the last
+            off_t.append(t)
+            b, t = leg_on(store, rep)
+            on_b = b
+            on_t.append(t)
+        store.close()
+
+        per_step_off = off_b / steps
+        per_step_on = on_b / steps
+        row = {
+            "metric": "hierarchical_wire_bytes_per_step",
+            "value": round(per_step_on / 1e6, 3),
+            "unit": "MB/step (mutation payloads, hierarchical on)",
+            "off_mb_per_step": round(per_step_off / 1e6, 3),
+            "byte_reduction_x": round(per_step_off / per_step_on, 3),
+            "local_size": workers,
+            "ms_per_step_on": round(min(on_t) * 1e3, 2),
+            "ms_per_step_off": round(min(off_t) * 1e3, 2),
+            "speedup_min": round(min(off_t) / min(on_t), 3),
+            "tensor_mb": mb,
+            "shards": shards,
+            "wire": f"emulated {delay_ms:g}ms/hop (proxy)",
+            "window": get_config().wire_window,
+            "tool": "bench_comm.py",
+        }
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    finally:
+        set_config(saved_cfg)
+        for px in proxies:
+            px.close()
+        for pr in procs:
+            pr.terminate()
+        for pr in procs:
+            try:
+                pr.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pr.kill()
+                pr.wait(timeout=5)
+    if archive and rows:
+        _archive_rows(rows)
+    return rows
+
+
 def _archive_rows(rows, path="BENCH_COMM.json"):
     """Merge rows into BENCH_COMM.json by metric name (acceptance
     artifact: the pipelined-wire numbers live next to the PR-4-era
@@ -549,9 +685,15 @@ def main():
     ap.add_argument("--wire-reps", type=int, default=8)
     ap.add_argument("--wire-only", action="store_true",
                     help="run only the pipelined-wire A/B + the "
-                         "per-transport A/B")
+                         "per-transport A/B + the hierarchical A/B")
     ap.add_argument("--transports-only", action="store_true",
                     help="run only the per-transport same-host A/B")
+    ap.add_argument("--hierarchical", action="store_true",
+                    help="run only the hierarchical on-vs-off A/B "
+                         "(docs/wire.md 'Hierarchical reduction')")
+    ap.add_argument("--hier-workers", type=int, default=4,
+                    help="emulated colocated worker count (= local_size)")
+    ap.add_argument("--hier-mb", type=int, default=2)
     # 1 MiB frames: the partition-sized regime the colocated client
     # actually sends, where per-frame transport cost dominates; 24
     # interleaved reps so min-of-reps escapes this host's throttle
@@ -566,11 +708,19 @@ def main():
         transport_ab(mb=args.transport_mb, reps=args.transport_reps,
                      archive=not args.no_archive)
         return
+    if args.hierarchical:
+        hierarchical_ab(workers=args.hier_workers, mb=args.hier_mb,
+                        delay_ms=args.wire_delay_ms,
+                        archive=not args.no_archive)
+        return
     pipelined_wire(mb=args.wire_mb, part_kb=args.wire_part_kb,
                    delay_ms=args.wire_delay_ms, reps=args.wire_reps,
                    archive=not args.no_archive)
     transport_ab(mb=args.transport_mb, reps=args.transport_reps,
                  archive=not args.no_archive)
+    hierarchical_ab(workers=args.hier_workers, mb=args.hier_mb,
+                    delay_ms=args.wire_delay_ms,
+                    archive=not args.no_archive)
     if args.wire_only:
         return
 

@@ -89,6 +89,59 @@ class _GlobalState:
 _state = _GlobalState()
 
 
+def _validate_local_contract(cfg) -> None:
+    """Launcher-injected ``BYTEPS_LOCAL_RANK``/``BYTEPS_LOCAL_SIZE`` must
+    match the mesh/process reality.  With hierarchical push/pull
+    (docs/wire.md "Hierarchical reduction") a silently wrong local rank
+    means pushing the WRONG SLICE of every gradient — corrupt global
+    state, not just a mislabeled log line — so mismatches raise loudly
+    at init instead of surfacing as training divergence."""
+    lr, ls = cfg.local_rank, cfg.local_size
+    if ls is not None and ls < 1:
+        raise ValueError(f"BYTEPS_LOCAL_SIZE={ls} must be >= 1")
+    nproc = jax.process_count()
+    # range-check the rank only against an EXPLICIT local_size — the
+    # device-count default is devices-per-process, which is the wrong
+    # bound for a several-processes-per-host launcher topology
+    if lr is not None and ls is not None and not 0 <= lr < ls:
+        raise ValueError(
+            f"BYTEPS_LOCAL_RANK={lr} is out of range for "
+            f"BYTEPS_LOCAL_SIZE={ls}: under hierarchical push/pull this "
+            "worker would push slice keys no group member owns (corrupt "
+            "gradients). Fix the launcher's injected values.")
+    if lr is not None and ls is None and nproc > 1 and lr >= nproc:
+        raise ValueError(
+            f"BYTEPS_LOCAL_RANK={lr} exceeds the {nproc}-process world "
+            "— no host has that many colocated workers. Fix the "
+            "launcher env (or set BYTEPS_LOCAL_SIZE explicitly).")
+    if nproc == 1:
+        if lr not in (None, 0):
+            raise ValueError(
+                f"BYTEPS_LOCAL_RANK={lr} but this run has a single "
+                f"process: its slice-mates do not exist, so every "
+                f"hierarchical push would ship only slice {lr} and drop "
+                "the rest. Unset BYTEPS_LOCAL_RANK (or set it to 0).")
+        if ls is not None and ls > jax.local_device_count():
+            raise ValueError(
+                f"BYTEPS_LOCAL_SIZE={ls} exceeds this process's "
+                f"{jax.local_device_count()} devices — no mesh axis can "
+                "host the local reduce-scatter. Shrink it, or launch "
+                "the missing colocated workers.")
+    else:
+        if ls is not None and nproc % ls != 0:
+            raise ValueError(
+                f"BYTEPS_LOCAL_SIZE={ls} does not divide the "
+                f"{nproc}-process world — hosts would disagree on the "
+                "hierarchical slice layout.")
+        if lr is not None and ls is not None and ls > 1 \
+                and lr != jax.process_index() % ls:
+            raise ValueError(
+                f"BYTEPS_LOCAL_RANK={lr} contradicts process index "
+                f"{jax.process_index()} under local_size {ls} (expected "
+                f"{jax.process_index() % ls}): this worker would push "
+                "another rank's slice. Fix the launcher env.")
+
+
 def init(
     mesh: Optional[jax.sharding.Mesh] = None,
     devices: Optional[Sequence[jax.Device]] = None,
@@ -105,6 +158,7 @@ def init(
             return
         _maybe_distributed_init()
         cfg = get_config()
+        _validate_local_contract(cfg)
         if mesh is None:
             shape = mesh_shape or _mesh_mod.parse_mesh_shape(cfg.mesh_shape)
             mesh = _mesh_mod.build_mesh(
@@ -261,6 +315,7 @@ def push_pull(
     priority: int = 0,
     compression: Any = Compression.none,
     axis_name: Optional[Any] = None,
+    hierarchical: Optional[bool] = None,
 ):
     """Sum (or average) a tensor across workers.
 
@@ -281,6 +336,16 @@ def push_pull(
     schemes apply statelessly here (compress→decompress on each
     contribution, no error feedback): right for one-shot reductions;
     training loops should carry EF via DistributedOptimizer instead.
+
+    ``hierarchical`` (default: ``BYTEPS_HIERARCHICAL``) applies to the
+    eager path when async-PS mode is on (``BYTEPS_ENABLE_ASYNC``): the
+    contributions are reduce-scattered over the mesh's reduce axes by a
+    jitted ``psum_scatter`` and only per-rank slices (``name@s{r}``)
+    ride the PS wire; a jitted ``all_gather`` rebuilds the result
+    on-device (docs/wire.md "Hierarchical reduction").  Note the PS
+    store ACCUMULATES per name — pass a fresh (or no) name for one-shot
+    reductions.  The in-graph ``axis_name`` path is already hierarchical
+    by construction and ignores the flag.
     """
     compression = Compression.resolve(compression)
     if axis_name is not None:
@@ -296,8 +361,30 @@ def push_pull(
     handle = push_pull_async(
         tensor, average=average, name=name, version=version,
         priority=priority, compression=compression,
+        hierarchical=hierarchical,
     )
     return synchronize(handle)
+
+
+def _hierarchical_ps_push_pull(stacked, name: str, average: bool) -> int:
+    """The mesh-aware eager PS data path (docs/wire.md "Hierarchical
+    reduction"): a jitted ``psum_scatter`` over the mesh's reduce axes
+    reduces the stacked contributions so each rank holds only its
+    1/local_size slice, the slices ride the async-PS wire as
+    independent ``name@s{r}`` sub-tensors, and a jitted ``all_gather``
+    rebuilds the pulled global state on-device.  Completes
+    synchronously; the returned handle is already done."""
+    from .common.types import Status
+    from .engine.async_ps import get_async_store
+    from .engine.hierarchical import hierarchical_push_pull
+
+    engine = _dispatcher.get_engine()
+    out = hierarchical_push_pull(
+        get_async_store(), name, stacked, _state.mesh,
+        axis=tuple(_state.reduce_axes), average=average)
+    handle = engine.handles.allocate()
+    engine.handles.mark_done(handle, Status.OK(), out)
+    return handle
 
 
 def push_pull_async(
@@ -307,6 +394,7 @@ def push_pull_async(
     version: int = 0,
     priority: int = 0,
     compression: Any = Compression.none,
+    hierarchical: Optional[bool] = None,
 ) -> int:
     """Async eager push_pull; returns a handle (reference torch/ops.py:144-183).
 
@@ -318,6 +406,7 @@ def push_pull_async(
     leading worker axis and drained by the engine's scheduler threads.
     """
     _require_init()
+    cfg = get_config()
     compression = Compression.resolve(compression)
     engine = _dispatcher.get_engine()
     wire = getattr(compression, "wire_dtype", None)
@@ -339,6 +428,21 @@ def push_pull_async(
         )
     stacked = _maybe_roundtrip(stacked, compression, stacked=True,
                                name=name or "")
+    hier = cfg.hierarchical if hierarchical is None else bool(hierarchical)
+    if hier and cfg.enable_async and _state.reduce_axes:
+        # the hierarchical eager PS path: local mesh reduce-scatter,
+        # slice-keyed wire exchange, on-device all_gather rebuild.
+        # Meshes without data axes keep the engine path (routing them
+        # to the store would scatter over a model-parallel axis).
+        # Cast compression applies per contribution (the bytes each
+        # worker would put on the wire); version/priority are inert
+        # here like on push_pull_async_process — the store orders by
+        # first-touch name priority.
+        if wire is not None:
+            stacked = jnp.asarray(stacked).astype(wire).astype(
+                jnp.asarray(stacked).dtype)
+        return _hierarchical_ps_push_pull(stacked, name or _auto_name(),
+                                          average)
     return engine.push_pull_async(
         stacked,
         name or _auto_name(),

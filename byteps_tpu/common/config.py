@@ -189,6 +189,19 @@ class Config:
     # connection maps two rings of this size)
     transport_shm_mb: int = 4
 
+    # --- hierarchical push/pull (engine/hierarchical.py; the reference's
+    # signature bandwidth move — NcclManager reduce-scatter inside the
+    # machine, then push only 1/local_size of every gradient to the
+    # server tier (SURVEY.md §1 "Local communication", docs/rationale.md
+    # bandwidth-optimality argument); docs/wire.md "Hierarchical
+    # reduction") --------------------------------------------------------
+    # slice eager PS mutations into local_size sub-tensors keyed
+    # name@s{r}: each colocated worker ships only its rank's slice
+    hierarchical: bool = False
+    # tensors below this many bytes (and 0-d scalars) pass through
+    # unsliced — per-slice frame headers would eat the win
+    hierarchical_min_bytes: int = 1024
+
     # --- gradient wire compression (byteps_tpu/compression/; the
     # reference reserved kCompressedPushPull, common.h:212-216, and never
     # implemented it — docs/compression.md) ------------------------------
@@ -260,6 +273,9 @@ class Config:
             transport_dir=_env_str("BYTEPS_TRANSPORT_DIR", ""),
             transport_overrides=_env_str("BYTEPS_TRANSPORT_OVERRIDES", ""),
             transport_shm_mb=_env_int("BYTEPS_TRANSPORT_SHM_MB", 4),
+            hierarchical=_env_bool("BYTEPS_HIERARCHICAL"),
+            hierarchical_min_bytes=_env_int(
+                "BYTEPS_HIERARCHICAL_MIN_BYTES", 1024),
             compression=_env_str("BYTEPS_COMPRESSION", ""),
             compression_min_bytes=_env_int("BYTEPS_MIN_COMPRESS_BYTES", 1024),
             compression_overrides=_env_str(

@@ -1,0 +1,313 @@
+"""Hierarchical push/pull — the local-mesh reduce-scatter stage below the
+PS tier (docs/wire.md "Hierarchical reduction").
+
+BytePS's signature bandwidth argument (PAPER.md "Local communication";
+reference ``NcclManager`` reduce-scatter -> push partials -> pull ->
+allgather, core_loops.cc:170-206/430-502; docs/rationale.md) is that
+colocated workers must reduce *inside* the machine first, so each worker
+ships only its ``1/local_size`` slice of every gradient to the server
+tier instead of the full tensor.  The in-graph collective path renders
+this natively (``parallel/collectives.push_pull_shard``); this module is
+the **eager PS data path** rendering:
+
+  * ``slice_spans`` — the slice math: the flat element space of a tensor
+    is split into ``local_size`` contiguous near-equal chunks (equal
+    ``ceil(n/L)`` chunks with a ragged last slice, matching exactly the
+    chunk layout ``lax.psum_scatter`` produces on the padded buffer, so
+    the wire slice boundary and the on-device scatter boundary are the
+    same bytes);
+  * slice keying — slice ``r`` of tensor ``name`` travels as the
+    independent sub-tensor ``name@s{r}``, riding the existing
+    ``name#p{i}`` partition / version-guard / exactly-once / failover
+    machinery of ``engine/ps_server.py`` unchanged (a slice larger than
+    ``BYTEPS_PARTITION_BYTES`` further splits into ``name@s{r}#p{i}``);
+  * ``hierarchical_push_pull`` — the group-level exchange: a jitted
+    ``psum_scatter`` over the local mesh axis reduces the members'
+    contributions (one traced program per padded shape bucket,
+    ``parallel/collectives.local_reduce_scatter``), each rank's slice is
+    pushed through the store, the pulled global slices are rebuilt into
+    the full tensor by a jitted ``all_gather``
+    (``collectives.local_all_gather``).
+
+Eligibility: 0-d scalars and tensors below
+``BYTEPS_HIERARCHICAL_MIN_BYTES`` pass through unsliced (per-slice frame
+headers would eat the win), as do tensors too small for every slice to
+be non-empty.  Bit-exactness: slicing is an elementwise partition of the
+flat tensor — the server performs the same elementwise adds on the same
+values in the same per-key order whether they arrive as one tensor or as
+``local_size`` slices, so hierarchical-on and -off are bit-identical for
+a single writer (pinned in tests/test_hierarchical.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..common import logging as bps_log
+
+SLICE_SEP = "@s"
+
+
+def slice_chunk(n: int, local_size: int) -> int:
+    """Elements per slice chunk: ``ceil(n / L)`` — the chunk size
+    ``lax.psum_scatter`` yields on the ``L * ceil(n/L)``-padded buffer."""
+    return -(-n // local_size)
+
+
+def slice_spans(n: int, local_size: int) -> Optional[List[Tuple[int, int]]]:
+    """``[(start, stop)]`` flat-element spans of the ``local_size``
+    slices of an ``n``-element tensor: equal ``ceil(n/L)`` chunks with a
+    ragged last slice.  None when slicing is degenerate — ``L <= 1`` or
+    ``n`` too small for every slice to be non-empty (an empty slice
+    would be a keyed tensor no rank ever pushes, wedging version
+    queries and failover)."""
+    if local_size <= 1 or n <= 0:
+        return None
+    c = slice_chunk(n, local_size)
+    if (local_size - 1) * c >= n:
+        return None  # the last slice would be empty
+    return [(r * c, min((r + 1) * c, n)) for r in range(local_size)]
+
+
+def slice_name(name: str, rank: int) -> str:
+    """Wire key of slice ``rank``: the independent sub-tensor the PS
+    tier sums per-slice exactly as it would a full tensor."""
+    return f"{name}{SLICE_SEP}{rank}"
+
+
+def is_sliced_name(name: str) -> bool:
+    """True for names that already carry slice (or partition) markers —
+    they must never be re-sliced."""
+    return SLICE_SEP in name or "#p" in name
+
+
+def parse_slice_rank(name: str, base: str) -> Optional[int]:
+    """Rank ``r`` if ``name`` is ``base@s{r}`` (possibly with a
+    ``#p{i}`` partition suffix), else None."""
+    prefix = base + SLICE_SEP
+    if not name.startswith(prefix):
+        return None
+    tail = name[len(prefix):].split("#", 1)[0]
+    return int(tail) if tail.isdigit() else None
+
+
+def eligible(arr: np.ndarray, local_size: int, min_bytes: int) -> bool:
+    """Whether ``arr`` is sliced under the hierarchical contract:
+    0-d scalars and sub-threshold tensors pass through unsliced."""
+    if local_size <= 1 or arr.ndim == 0:
+        return False
+    if arr.nbytes < max(1, min_bytes):
+        return False
+    return slice_spans(arr.size, local_size) is not None
+
+
+# ---------------------------------------------------------------------------
+# Group-level exchange: jitted scatter -> slice push/pull -> jitted gather
+# ---------------------------------------------------------------------------
+
+
+class _InitLedger:
+    """Per-(store, name) first-touch latch so the group exchange INITs a
+    fresh key exactly once without a names() round trip per call."""
+
+    def __init__(self):
+        import weakref
+
+        self._seen = weakref.WeakKeyDictionary()
+
+    def first_touch(self, store, name: str) -> bool:
+        names = self._seen.setdefault(store, set())
+        if name in names:
+            return False
+        names.add(name)
+        return True
+
+
+_ledger = _InitLedger()
+
+
+def _resolve_axes(mesh, axis) -> Tuple[str, ...]:
+    """The local mesh axes the scatter runs over (innermost by
+    default), normalized to a tuple."""
+    if axis is None:
+        return (mesh.axis_names[-1],)
+    axes = (axis,) if isinstance(axis, str) else tuple(axis)
+    for a in axes:
+        if a not in mesh.axis_names:
+            raise ValueError(
+                f"hierarchical axis {a!r} is not a mesh axis "
+                f"{mesh.axis_names}")
+    return axes
+
+
+def _pad_rows(rows: np.ndarray, npad: int) -> np.ndarray:
+    if rows.shape[1] == npad:
+        return rows
+    out = np.zeros((rows.shape[0], npad), rows.dtype)
+    out[:, : rows.shape[1]] = rows
+    return out
+
+
+def _local_slices(flat_sharded, spans, chunk: int) -> Dict[int, np.ndarray]:
+    """This process's slices of the scattered buffer: one per
+    *addressable* chunk (all of them single-controller; only this
+    host's ranks in a multi-process run) — the 1/local_size wire
+    contract falls out of addressability."""
+    out: Dict[int, np.ndarray] = {}
+    for shard in flat_sharded.addressable_shards:
+        start = shard.index[0].start or 0
+        r = start // chunk
+        if r >= len(spans):
+            continue
+        a, b = spans[r]
+        out[r] = np.asarray(shard.data)[: b - a]
+    return out
+
+
+def hierarchical_push_pull(store, name: str, stacked, mesh,
+                           axis: Optional[str] = None,
+                           average: bool = False,
+                           min_bytes: Optional[int] = None):
+    """The hierarchical eager PS exchange (PS semantics — the store
+    accumulates: the result is ``init + sum of every delta ever
+    pushed``, like ``RemoteStore.push_pull``):
+
+      1. jitted ``psum_scatter`` over the local mesh ``axis`` reduces
+         ``stacked[r]`` (member ``r``'s delta contribution, shape
+         ``[axis_size, ...]``) so rank ``r`` holds slice ``r`` of the
+         local sum;
+      2. each rank's slice is pushed as ``name@s{r}`` — on a
+         multi-process mesh each process ships only its addressable
+         ranks' slices: the ``1/local_size`` wire-byte contract;
+      3. the pulled global slices are rebuilt into the full tensor by a
+         jitted ``all_gather`` — returned replicated over the mesh.
+
+    ``average=True`` pushes the member *mean* instead of the sum (the
+    DistributedOptimizer convention).  A fresh ``name`` is zero-INIT'd
+    on first touch, so a one-shot exchange returns exactly this round's
+    reduction.  Ineligible tensors (sub-``min_bytes``, scalars, too
+    small to slice) fall back to a local reduce + an unsliced
+    ``store.push_pull`` — same semantics, no slicing.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..common.config import get_config
+    from ..parallel import collectives
+
+    axes = _resolve_axes(mesh, axis)
+    L = 1
+    for a in axes:
+        L *= int(mesh.shape[a])
+    arr = np.asarray(stacked)
+    if arr.ndim == 0 or arr.shape[0] != L:
+        raise ValueError(
+            f"hierarchical_push_pull expects contributions stacked on a "
+            f"leading axis of length {L} (mesh axes {axes!r}); got shape "
+            f"{arr.shape}")
+    if min_bytes is None:
+        min_bytes = get_config().hierarchical_min_bytes
+    row_shape = arr.shape[1:]
+    rows = arr.reshape(L, -1)
+    n = int(rows.shape[1])
+
+    if not eligible(arr[0] if row_shape else rows[0], L, min_bytes):
+        # pass-through: local reduce, one unsliced exchange
+        reduced = rows.sum(axis=0, dtype=rows.dtype)
+        if average:
+            reduced = (reduced / L).astype(rows.dtype)
+        if _ledger.first_touch(store, name):
+            store.init_tensor(name, np.zeros(n, rows.dtype))
+        out = np.asarray(store.push_pull(name, reduced))
+        return jnp.asarray(out.reshape(row_shape))
+
+    spans = slice_spans(n, L)
+    chunk = slice_chunk(n, L)
+    npad = chunk * L
+    scattered = collectives.local_reduce_scatter(
+        _pad_rows(rows, npad), mesh, axes)
+    if average:
+        scattered = (scattered / L).astype(rows.dtype)
+    mine = _local_slices(scattered, spans, chunk)
+    if _ledger.first_touch(store, name):
+        _slice_init(store, name, spans, rows.dtype, L)
+    exchange = getattr(store, "push_pull_slices", None)
+    if exchange is None:  # duck-typed in-process store
+        pulled = push_pull_slices_fallback(store, name, mine, L)
+    else:
+        pulled = exchange(name, mine, L)
+    # rebuild: pulled slices -> padded flat laid out P(axes) -> all_gather
+    flat = np.zeros(npad, rows.dtype)
+    for r, s in sorted(pulled.items()):
+        a, b = spans[r]
+        flat[r * chunk: r * chunk + (b - a)] = np.asarray(s).reshape(-1)
+    if jax.process_count() == 1:
+        # single controller: this process pulled EVERY rank's slice, so
+        # ``flat`` already is the full tensor — replicate it onto the
+        # mesh directly instead of paying a no-op all_gather dispatch
+        # per exchange (the collective is the multi-process rebuild)
+        return collectives.replicate(
+            flat[:n].reshape(row_shape).astype(arr.dtype), mesh)
+    from jax.sharding import NamedSharding  # pragma: no cover - multihost
+    from jax.sharding import PartitionSpec as P
+
+    sharding = NamedSharding(mesh, P(axes))
+    local = np.concatenate(
+        [flat[r * chunk: (r + 1) * chunk] for r in sorted(mine)])
+    sharded = jax.make_array_from_process_local_data(sharding, local)
+    full = collectives.local_all_gather(sharded, mesh, axes)
+    return full[:n].reshape(row_shape).astype(arr.dtype)
+
+
+def _slice_init(store, name: str, spans, dtype, total: int) -> None:
+    """Zero-INIT every slice key of a fresh name (first-push-wins, so a
+    racing sibling's INIT is harmless)."""
+    init_slices = getattr(store, "init_slices", None)
+    zeros = {r: np.zeros(b - a, dtype) for r, (a, b) in enumerate(spans)}
+    if init_slices is not None:
+        init_slices(name, zeros, total)
+        return
+    for r, z in zeros.items():  # duck-typed store without the slice API
+        store.init_tensor(slice_name(name, r), z)
+
+
+def push_pull_slices_fallback(store, name: str,
+                              slices: Dict[int, np.ndarray],
+                              total: int) -> Dict[int, np.ndarray]:
+    """Slice exchange against a store without the native slice API
+    (in-process ``AsyncParameterServer``/``ShardedParameterStore``):
+    one plain ``push_pull`` per slice key."""
+    del total
+    return {r: np.asarray(store.push_pull(slice_name(name, r), s))
+            for r, s in sorted(slices.items())}
+
+
+def describe(name: str, nelems: int, local_size: int, min_bytes: int,
+             partition_bytes: int, itemsize: int = 4) -> str:
+    """Human-readable slicing decision for one tensor — the FAQ
+    debugging helper ("why didn't my wire bytes drop")."""
+    nbytes = nelems * itemsize
+    if local_size <= 1:
+        return (f"{name}: local_size={local_size} -> unsliced (no "
+                "colocated group; the local reduction has nothing to "
+                "scatter over)")
+    if nbytes < min_bytes:
+        return (f"{name}: {nbytes}B < BYTEPS_HIERARCHICAL_MIN_BYTES="
+                f"{min_bytes} -> unsliced (headers would eat the win)")
+    spans = slice_spans(nelems, local_size)
+    if spans is None:
+        return f"{name}: {nelems} elems too small for {local_size} slices"
+    c = spans[0][1] - spans[0][0]
+    parts = -(-c * itemsize // max(1, partition_bytes))
+    return (f"{name}: {local_size} slices of <={c} elems "
+            f"({c * itemsize}B), {parts} partition(s) each vs "
+            f"BYTEPS_PARTITION_BYTES={partition_bytes}")
+
+
+__all__ = [
+    "SLICE_SEP", "slice_spans", "slice_chunk", "slice_name",
+    "is_sliced_name", "parse_slice_rank", "eligible",
+    "hierarchical_push_pull", "push_pull_slices_fallback", "describe",
+]

@@ -98,6 +98,7 @@ from .async_ps import AsyncParameterServer
 # framing codec + pipeline live in engine/wire.py; re-exported here
 # because the chaos proxy, the serving frontend and tests import them
 # from this module (one wire framing, one reader)
+from . import hierarchical as hier
 from .transport import (LocalEndpoints, connection_kind, maybe_nodelay,
                         parse_overrides, peer_label, resolve_transport,
                         transport_connect)
@@ -564,12 +565,27 @@ class RemoteStore:
     and placed per partition; ``names()`` lists partition names).
     Partitioned tensors must be init'd or pushed through this client
     before ``pull``/``version`` can reassemble them.
+
+    Hierarchical slicing (engine/hierarchical.py — docs/wire.md
+    "Hierarchical reduction"): with ``BYTEPS_HIERARCHICAL`` (or
+    ``hierarchical=True``) every eligible mutation is split into
+    ``local_size`` slice keys ``name@s{r}`` *above* the partition layer
+    — slices compress, version-guard, fail over and carry error-feedback
+    residuals independently (they are ordinary wire names), and all
+    slices of one op fan out through a single pipelined window pass.
+    0-d scalars and tensors under ``BYTEPS_HIERARCHICAL_MIN_BYTES`` pass
+    through unsliced.  ``push_pull_slices``/``init_slices`` expose the
+    per-rank entry points the group-level exchange
+    (``hierarchical.hierarchical_push_pull``) pushes single slices
+    through.
     """
 
     def __init__(self, addrs: List[str], use_hash: bool = False,
                  timeout: float = 30.0, retry_policy=None, counters=None,
                  heartbeat: Optional[float] = None, compression=None,
-                 wire_window: Optional[int] = None, transport=None):
+                 wire_window: Optional[int] = None, transport=None,
+                 hierarchical: Optional[bool] = None,
+                 local_size: Optional[int] = None):
         from ..common.config import get_config
         from ..common.context import ServerSharder
         from ..compression import (CompressionPolicy, WireCompressor,
@@ -656,6 +672,25 @@ class RemoteStore:
         self._trace_rpc = rpc_tracing_enabled(cfg)
         self._partition_bytes = cfg.effective_partition_bytes
         self._part_meta: dict = {}  # base name -> (nparts, shape, dtype)
+        # hierarchical slicing (docs/wire.md "Hierarchical reduction"):
+        # eligible tensors split into local_size slice keys name@s{r}
+        # above the partition layer.  local_size resolution: explicit
+        # argument > launcher-injected BYTEPS_LOCAL_SIZE > the process's
+        # device count (the reference's GPU-count analog).
+        self._hier = (cfg.hierarchical if hierarchical is None
+                      else bool(hierarchical))
+        self._hier_min = max(1, cfg.hierarchical_min_bytes)
+        if local_size is not None:
+            self._hier_L = max(1, int(local_size))
+        elif cfg.local_size is not None:
+            self._hier_L = max(1, int(cfg.local_size))
+        elif self._hier:
+            import jax
+
+            self._hier_L = max(1, jax.local_device_count())
+        else:
+            self._hier_L = 1
+        self._hier_meta: dict = {}  # base name -> (nslices, shape, dtype)
         # failover/restart seed cache (_last_global).  Off when the user
         # disabled BYTEPS_FAILOVER outright: the snapshots exist purely
         # to re-seed shards, so keeping multi-MB copies of every reply
@@ -1388,6 +1423,148 @@ class RemoteStore:
             self._part_meta[name] = meta
         return meta
 
+    # ------------------------------------------- hierarchical slices
+
+    def _hier_slices(self, name: str, arr: np.ndarray):
+        """``[(slice_key, flat_view)]`` when ``arr`` falls under the
+        hierarchical contract (docs/wire.md "Hierarchical reduction"),
+        else None.  Slices are zero-copy views of the flat tensor —
+        contiguous spans per ``hier.slice_spans`` — and reassembly meta
+        is recorded like ``_partition``'s."""
+        if not self._hier or self._hier_L <= 1:
+            return None
+        if hier.is_sliced_name(name):
+            return None  # slice/partition keys are never re-sliced
+        if not hier.eligible(arr, self._hier_L, self._hier_min):
+            return None
+        arr = np.ascontiguousarray(arr)
+        spans = hier.slice_spans(arr.size, self._hier_L)
+        flat = arr.reshape(-1)
+        with self._state_lock:
+            self._hier_meta[name] = (len(spans), arr.shape, arr.dtype)
+        return [(hier.slice_name(name, r), flat[a:b])
+                for r, (a, b) in enumerate(spans)]
+
+    def _hier_meta_of(self, name: str):
+        with self._state_lock:
+            return self._hier_meta.get(name)
+
+    def _mutate_parts(self, op: int, name: str, arr: np.ndarray, encode,
+                      prio: int):
+        """Slice (when hierarchical) and partition one mutation, fanning
+        every resulting part through a single pipelined pass; outs come
+        back in span order, so ``_assemble_flat`` reassembles them
+        directly."""
+        sl = self._hier_slices(name, arr)
+        if sl is None:
+            parts = self._partition(name, arr)
+        else:
+            parts = [p for sname, sarr in sl
+                     for p in self._partition(sname, sarr)]
+        return self._pipeline_parts(op, parts, encode, prio)
+
+    def _discover_slices(self, name: str):
+        """A tensor sliced by ANOTHER client lives on the servers only
+        as ``name@s{r}`` keys (each possibly partitioned).  Discover the
+        rank set via ``names()``; reassembly is flat ``[n]`` (the
+        original shape is client-local knowledge), mirroring
+        ``_discover_parts``."""
+        ranks = set()
+        for n in self.names():
+            r = hier.parse_slice_rank(n, name)
+            if r is not None:
+                ranks.add(r)
+        if not ranks or sorted(ranks) != list(range(len(ranks))):
+            return None
+        bps_log.warning(
+            "%r was sliced hierarchically by another client; "
+            "reassembling %d slices as a flat [n] array (reshape "
+            "against your template)", name, len(ranks))
+        meta = (len(ranks), None, None)
+        with self._state_lock:
+            self._hier_meta[name] = meta
+        return meta
+
+    def _pull_sliced(self, name: str, hm, prio: int) -> np.ndarray:
+        """Pull every slice of ``name`` (one windowed fan-out pass over
+        all slice-parts) into one preallocated flat destination."""
+        nsl, shape, dtype = hm
+        if shape is None:
+            # discovery pull (sliced by another client): per-slice plain
+            # pulls own their partition discovery
+            chunks = [np.asarray(
+                self._pull_traced(hier.slice_name(name, r))).reshape(-1)
+                for r in range(nsl)]
+            return self._assemble_flat(chunks, dtype or chunks[0].dtype)
+        parts = []
+        for r in range(nsl):
+            sname = hier.slice_name(name, r)
+            pmeta = self._part_names(sname)
+            if pmeta is None:
+                parts.append((sname, None))
+            else:
+                parts.extend((f"{sname}#p{i}", None)
+                             for i in range(pmeta[0]))
+        chunks = [np.asarray(o).reshape(-1) for o in
+                  self._pipeline_parts(OP_PULL, parts, self._encode_raw,
+                                       prio)]
+        return self._assemble_flat(chunks, dtype).reshape(shape)
+
+    def _note_slice_meta(self, name: str, total: int, items) -> None:
+        """Record pull-side reassembly meta for a slice-API op — only
+        when the caller covers the WHOLE group (a multi-process caller
+        pushing just its rank owns its own reassembly; the shape is
+        flat because the slice API never sees the original one)."""
+        if len(items) != int(total) or not items:
+            return
+        n = sum(int(a.size) for _, a in items)
+        with self._state_lock:
+            self._hier_meta[name] = (int(total), (n,), items[0][1].dtype)
+
+    def init_slices(self, name: str, slices: dict, total: int) -> None:
+        """INIT the given rank slices of ``name`` (flat arrays keyed
+        ``name@s{r}``, first-push-wins per slice).  ``total`` is the
+        group's local_size."""
+        prio = self._priority_of(name)
+        items = [(r, np.ascontiguousarray(np.asarray(a).reshape(-1)))
+                 for r, a in sorted(slices.items())]
+        self._note_slice_meta(name, total, items)
+        parts = [p for r, arr in items
+                 for p in self._partition(hier.slice_name(name, r), arr)]
+        with self._traced("init", name):
+            self._pipeline_parts(OP_INIT, parts, self._encode_raw, prio)
+
+    def push_pull_slices(self, name: str, slices: dict,
+                         total: int) -> dict:
+        """Per-rank hierarchical exchange: push each given flat slice as
+        ``name@s{r}`` — every part of every slice rides ONE windowed
+        fan-out pass — and return the pulled global slices
+        ``{rank: flat array}``.  This is the entry point the group-level
+        ``hierarchical.hierarchical_push_pull`` ships single ranks
+        through (the 1/local_size wire contract)."""
+        prio = self._priority_of(name)
+        items = [(r, np.ascontiguousarray(np.asarray(a).reshape(-1)))
+                 for r, a in sorted(slices.items())]
+        self._note_slice_meta(name, total, items)
+        parts, counts = [], []
+        for r, arr in items:
+            p = self._partition(hier.slice_name(name, r), arr)
+            parts.extend(p)
+            counts.append(len(p))
+        with self._traced("push_pull", name):
+            outs = [np.asarray(o).reshape(-1) for o in
+                    self._pipeline_parts(OP_PUSH_PULL, parts,
+                                         self._compressor.encode_mutation,
+                                         prio)]
+        result = {}
+        off = 0
+        for (r, _), k in zip(items, counts):
+            result[r] = (np.array(outs[off]) if k == 1 else
+                         self._assemble_flat(outs[off:off + k],
+                                             outs[off].dtype))
+            off += k
+        return result
+
     @staticmethod
     def _encode_raw(pname, part):
         # identity "encode" for uncompressed legs (INIT / PULL)
@@ -1409,18 +1586,17 @@ class RemoteStore:
         # INIT stays raw: it seeds the authoritative global state, which
         # must not start life quantized
         prio = self._priority_of(name)
-        parts = self._partition(name, np.asarray(value))
         with self._traced("init", name):
-            self._pipeline_parts(OP_INIT, parts, self._encode_raw, prio)
+            self._mutate_parts(OP_INIT, name, np.asarray(value),
+                               self._encode_raw, prio)
 
     def push_delta(self, name: str, delta: np.ndarray,
                    priority: Optional[int] = None) -> None:
         # OP_PUSH replies status-only: no pointless global-tensor download
         prio = self._priority_of(name) if priority is None else priority
-        parts = self._partition(name, np.asarray(delta))
         with self._traced("push", name):
-            self._pipeline_parts(OP_PUSH, parts,
-                                 self._compressor.encode_mutation, prio)
+            self._mutate_parts(OP_PUSH, name, np.asarray(delta),
+                               self._compressor.encode_mutation, prio)
 
     def pull(self, name: str) -> np.ndarray:
         with self._traced("pull", name):
@@ -1428,6 +1604,9 @@ class RemoteStore:
 
     def _pull_traced(self, name: str) -> np.ndarray:
         prio = self._priority_of(name)
+        hm = self._hier_meta_of(name)
+        if hm is not None:
+            return self._pull_sliced(name, hm, prio)
         meta = self._part_names(name)
         if meta is None:
             try:
@@ -1435,13 +1614,17 @@ class RemoteStore:
                                    priority=prio)
                 return np.array(out)  # own the buffer
             except RuntimeError as e:
-                # possibly a tensor partitioned by another client (this
-                # one holds no meta): the store only knows name#p{i}
+                # possibly a tensor partitioned (or sliced) by another
+                # client (this one holds no meta): the store only knows
+                # name#p{i} / name@s{r}
                 if "KeyError" not in str(e):
                     raise
                 meta = self._discover_parts(name)
                 if meta is None:
-                    raise
+                    hm = self._discover_slices(name)
+                    if hm is None:
+                        raise
+                    return self._pull_sliced(name, hm, prio)
         nparts, shape, dtype = meta
         parts = [(f"{name}#p{i}", None) for i in range(nparts)]
         chunks = [np.asarray(o).reshape(-1) for o in
@@ -1454,26 +1637,34 @@ class RemoteStore:
                   priority: Optional[int] = None) -> np.ndarray:
         d = np.asarray(delta)
         prio = self._priority_of(name) if priority is None else priority
-        parts = self._partition(name, d)
         with self._traced("push_pull", name):
             outs = [np.asarray(o).reshape(-1) for o in
-                    self._pipeline_parts(OP_PUSH_PULL, parts,
-                                         self._compressor.encode_mutation,
-                                         prio)]
+                    self._mutate_parts(OP_PUSH_PULL, name, d,
+                                       self._compressor.encode_mutation,
+                                       prio)]
         if len(outs) == 1:
             return np.array(outs[0]).reshape(d.shape)
         return self._assemble_flat(outs, outs[0].dtype).reshape(d.shape)
 
     def version(self, name: str) -> int:
+        hm = self._hier_meta_of(name)
+        if hm is not None:
+            # a sliced tensor's version question means slice 0's (each
+            # slice carries an independent counter, like partitions)
+            return self.version(hier.slice_name(name, 0))
         meta = self._part_names(name)
         qname = name if meta is None else f"{name}#p0"
         try:
             _, payload = self._rpc(self._shard_of(qname), OP_VERSION, qname)
         except RuntimeError as e:
-            if (meta is not None or "KeyError" not in str(e)
-                    or self._discover_parts(name) is None):
+            if meta is not None or "KeyError" not in str(e):
                 raise
-            qname = f"{name}#p0"
+            if self._discover_parts(name) is not None:
+                qname = f"{name}#p0"
+            elif self._discover_slices(name) is not None:
+                return self.version(hier.slice_name(name, 0))
+            else:
+                raise
             _, payload = self._rpc(self._shard_of(qname), OP_VERSION, qname)
         return struct.unpack("<Q", payload)[0]
 

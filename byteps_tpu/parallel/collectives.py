@@ -279,3 +279,92 @@ def replicate(x, mesh: Mesh):
     """Place a host value on the mesh fully replicated."""
     sharding = NamedSharding(mesh, P())
     return jax.device_put(x, sharding)
+
+
+# ---------------------------------------------------------------------------
+# Eager local-mesh scatter/gather: the in-graph half of the hierarchical
+# PS data path (engine/hierarchical.py; docs/wire.md "Hierarchical
+# reduction").  ``local_reduce_scatter`` is the NcclManager reduce-scatter
+# stage of the reference (core_loops.cc:170-191) — run BEFORE an eager PS
+# push so each colocated worker ships only its 1/local_size slice —
+# and ``local_all_gather`` is the AllGather/broadcast return stage
+# (core_loops.cc:192-206) rebuilding the full tensor from pulled slices.
+# One traced program per (mesh, axis, padded-length) shape bucket.
+# ---------------------------------------------------------------------------
+
+
+def _axes_tuple(axis) -> Tuple[str, ...]:
+    return (axis,) if isinstance(axis, str) else tuple(axis)
+
+
+def _axes_size(mesh: Mesh, axes: Tuple[str, ...]) -> int:
+    n = 1
+    for a in axes:
+        n *= int(mesh.shape[a])
+    return n
+
+
+@functools.lru_cache(maxsize=None)
+def _local_scatter_fn(mesh: Mesh, axes: Tuple[str, ...], npad: int,
+                      dtype: str):
+    del npad, dtype  # cache keys only: one traced program per shape bucket
+
+    def f(x):  # x: [1, npad] — this member's row of the stacked input
+        return lax.psum_scatter(
+            x.reshape(-1), axes, scatter_dimension=0, tiled=True)
+
+    # out_specs P(axes): member r of the (flattened) axes holds chunk r
+    # of the reduced buffer — exactly the slice it pushes to the PS tier
+    return jax.jit(shard_map(f, mesh, in_specs=P(axes), out_specs=P(axes)))
+
+
+def local_reduce_scatter(stacked, mesh: Mesh, axis) -> jax.Array:
+    """Reduce ``stacked[w]`` contributions over the local mesh ``axis``
+    (a name or tuple of names — flattened row-major) and scatter the
+    sum: returns a flat ``[npad]`` array (npad = input row length, padded
+    by the caller to a multiple of the axis size) whose chunk ``r`` — as
+    laid out by ``hierarchical.slice_spans`` — lives on axis member
+    ``r``.  Call with ``stacked`` shaped ``[axis_size, npad]``."""
+    axes = _axes_tuple(axis)
+    n = _axes_size(mesh, axes)
+    if stacked.ndim != 2 or stacked.shape[0] != n:
+        raise ValueError(
+            f"local_reduce_scatter expects [axis_size={n}, npad]; got "
+            f"{stacked.shape}")
+    if stacked.shape[1] % n:
+        raise ValueError(
+            f"row length {stacked.shape[1]} is not a multiple of the "
+            f"local axis size {n} — pad first (engine/hierarchical.py "
+            "owns the span math)")
+    fn = _local_scatter_fn(mesh, axes, stacked.shape[1],
+                           str(stacked.dtype))
+    return fn(jnp.asarray(stacked))
+
+
+@functools.lru_cache(maxsize=None)
+def _local_gather_fn(mesh: Mesh, axes: Tuple[str, ...], npad: int,
+                     dtype: str):
+    del npad, dtype
+
+    def f(x):  # x: [npad / axis_size] — this member's pulled slice
+        return lax.all_gather(x, axes, axis=0, tiled=True)
+
+    return jax.jit(shard_map(f, mesh, in_specs=P(axes), out_specs=P()))
+
+
+def local_all_gather(flat_sharded, mesh: Mesh, axis) -> jax.Array:
+    """Rebuild the full flat buffer from per-member slices: input is a
+    flat ``[npad]`` value laid out (or shardable) as ``P(axis)`` — chunk
+    ``r`` is member ``r``'s pulled slice — and the result is the full
+    ``[npad]`` buffer replicated over the mesh."""
+    axes = _axes_tuple(axis)
+    n = _axes_size(mesh, axes)
+    flat_sharded = jnp.asarray(flat_sharded)
+    if flat_sharded.ndim != 1 or flat_sharded.shape[0] % n:
+        raise ValueError(
+            f"local_all_gather expects a flat buffer divisible by the "
+            f"axis size {n}; got {flat_sharded.shape}")
+    sharded = jax.device_put(flat_sharded, NamedSharding(mesh, P(axes)))
+    fn = _local_gather_fn(mesh, axes, flat_sharded.shape[0],
+                          str(flat_sharded.dtype))
+    return fn(sharded)

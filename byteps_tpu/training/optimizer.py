@@ -63,12 +63,35 @@ def resolve_compression(compression):
     return compression, None
 
 
+def resolve_local_axis(axes: Sequence[str],
+                       local_axis: Optional[str]) -> tuple:
+    """Split the reduce axes into ``(scatter_axis, sum_axes)`` — the
+    hierarchical structure of the 3-level reduction (docs/wire.md
+    "Hierarchical reduction"): the *local* axis (ICI — the reference's
+    NCCL reduce-scatter group) is scattered over, everything else (DCN /
+    the PS tier) is summed on the scattered shard.  Default: the
+    innermost (last) axis, the mesh convention.  ``local_axis`` pins it
+    explicitly and is validated against the reduce axes — a wrong local
+    axis would scatter over the slow tier and sum over the fast one,
+    silently inverting the bandwidth argument."""
+    axes = tuple(axes)
+    if local_axis is None:
+        return axes[-1], axes[:-1]
+    if local_axis not in axes:
+        raise ValueError(
+            f"local_axis={local_axis!r} is not one of the reduce axes "
+            f"{axes} — the local reduce-scatter must run over a mesh "
+            "axis the gradients are reduced across")
+    return local_axis, tuple(a for a in axes if a != local_axis)
+
+
 def push_pull_gradients(
     axis_name: Union[str, Sequence[str], None] = "dp",
     average: bool = True,
     compression: type = Compression.none,
     partition_bytes: Optional[int] = None,
     plan: Optional[BucketPlan] = None,
+    local_axis: Optional[str] = None,
 ) -> optax.GradientTransformation:
     """An optax transformation that allreduces incoming gradients across the
     data axes via the bucketed reduce-scatter/all-gather path.
@@ -76,7 +99,9 @@ def push_pull_gradients(
     Must run inside shard_map over a mesh containing ``axis_name`` (the
     innermost/ICI axis is the last element when a sequence is given; leading
     axes — e.g. ``"dcn"`` — are summed hierarchically on the scattered
-    shard, reference SURVEY.md §2.4 3-level reduction).
+    shard, reference SURVEY.md §2.4 3-level reduction).  ``local_axis``
+    pins which axis hosts the local reduce-scatter stage explicitly
+    (validated against the axes — see :func:`resolve_local_axis`).
     ``axis_name=None`` means single-worker: pass-through (the reference
     likewise short-circuits when size()==1).
 
@@ -111,6 +136,7 @@ def push_pull_gradients(
         if axis_name is None:
             return updates, state
         axes = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+        scatter, sums = resolve_local_axis(axes, local_axis)
         # single-worker short-circuit (reference does the same when
         # size()==1): with |axes|==1 the collectives are no-ops but the
         # bucket gather/scatter copies are not — skip them entirely.
@@ -122,8 +148,8 @@ def push_pull_gradients(
         reduced = push_pull_tree(
             updates,
             plan=plan,
-            scatter_axis=axes[-1],
-            sum_axes=axes[:-1],
+            scatter_axis=scatter,
+            sum_axes=sums,
             average=average,
             wire_dtype=wire,
             partition_bytes=pb,
@@ -142,6 +168,7 @@ def DistributedOptimizer(
     average: bool = True,
     partition_bytes: Optional[int] = None,
     plan: Optional[BucketPlan] = None,
+    local_axis: Optional[str] = None,
 ) -> optax.GradientTransformation:
     """Wrap an optax optimizer so its gradients are push_pulled across
     workers first (reference torch/__init__.py:383-402 factory).
@@ -153,6 +180,12 @@ def DistributedOptimizer(
     extra chain level in the opt_state, holding the fp32 residual
     pytree).
 
+    ``local_axis`` names the mesh axis hosting the local (ICI)
+    reduce-scatter stage of the hierarchical reduction — the
+    ``NcclManager`` group of the reference (docs/wire.md "Hierarchical
+    reduction").  Default: the innermost of ``axis_name``; an axis not
+    in ``axis_name`` raises at build time.
+
     Usage inside a shard_mapped train step::
 
         opt = bps.DistributedOptimizer(optax.sgd(0.1), axis_name="dp",
@@ -161,6 +194,12 @@ def DistributedOptimizer(
     """
     del named_parameters
     cast, ef_tx = resolve_compression(compression)
+    # validate eagerly: a bad local_axis must fail at build time, not
+    # from inside the traced update
+    if axis_name is not None:
+        axes = ((axis_name,) if isinstance(axis_name, str)
+                else tuple(axis_name))
+        resolve_local_axis(axes, local_axis)
     links = [] if ef_tx is None else [ef_tx]
     links.append(
         push_pull_gradients(
@@ -169,6 +208,7 @@ def DistributedOptimizer(
             compression=cast,
             partition_bytes=partition_bytes,
             plan=plan,
+            local_axis=local_axis,
         ))
     links.append(optimizer)
     tx = optax.chain(*links)

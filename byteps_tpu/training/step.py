@@ -105,6 +105,7 @@ def make_data_parallel_step(
     partition_bytes: Optional[int] = None,
     backward_passes_per_step: int = 1,
     donate: bool = True,
+    local_axis: Optional[str] = None,
 ):
     """Build a jitted data-parallel train step.
 
@@ -119,6 +120,10 @@ def make_data_parallel_step(
     all workers via the bucketed scheduled push_pull; BatchNorm normalizes
     per-replica (torchvision semantics) while running stats are averaged
     across replicas so the state stays replicated.
+
+    ``local_axis`` pins which of ``axes`` hosts the local (ICI)
+    reduce-scatter stage of the hierarchical reduction (docs/wire.md
+    "Hierarchical reduction"); default: the innermost axis.
 
     .. note:: At ``world == 1`` (with ``backward_passes_per_step == 1``)
        the DistributedOptimizer wrapper is dropped — matching the
@@ -153,6 +158,7 @@ def make_data_parallel_step(
             average=True,
             partition_bytes=partition_bytes or get_config().partition_bytes,
             backward_passes_per_step=backward_passes_per_step,
+            local_axis=local_axis,
         )
 
     def local_step(state: TrainState, batch):

@@ -37,10 +37,17 @@ With ``--kill-shard-at N`` the chaos run additionally hard-kills shard 1
 the remaining steps run degraded — the clean run has no kill, so the
 bit-for-bit verdict also proves failover re-seeding loses nothing.
 
+With ``--hierarchical`` (docs/wire.md "Hierarchical reduction") every
+eligible tensor is sliced into ``name@s{r}`` sub-tensors (local_size 4),
+so each training push fans out as independent slice mutations — the
+bit-for-bit verdict then additionally proves the per-slice version
+guards, per-slice EF residual commits and per-slice failover re-seeds
+are exactly-once in any completion order.
+
 Usage:
     python scripts/chaos_smoke.py [--steps 60] [--seed 0] [--rate 0.15]
                                   [--compression randomk] [--window 8]
-                                  [--partition-bytes 64]
+                                  [--partition-bytes 64] [--hierarchical]
                                   [--transport unix] [--kill-shard-at 30]
 
 Wired into CI as ``slow``-marked pytests (tests/test_chaos_smoke.py —
@@ -63,7 +70,7 @@ def run(steps: int = 60, seed: int = 0, rate: float = 0.15,
         dim: int = 16, verbose: bool = True,
         compression: str = "", window: int = None,
         partition_bytes: int = None, transport: str = None,
-        kill_shard_at: int = None) -> dict:
+        kill_shard_at: int = None, hierarchical: bool = False) -> dict:
     import dataclasses
 
     from byteps_tpu.common.config import get_config, set_config
@@ -73,14 +80,21 @@ def run(steps: int = 60, seed: int = 0, rate: float = 0.15,
                                        ResilienceCounters, RetryPolicy)
 
     saved_cfg = get_config()
+    overrides = {}
     if partition_bytes is not None:
         # split every tensor into wire partitions (align small enough
         # that tiny smoke tensors actually split).  replace(), not a
         # fresh Config: env-derived knobs (BYTEPS_FAILOVER,
         # BYTEPS_WIRE_WINDOW, ...) must keep applying to the run
-        set_config(dataclasses.replace(saved_cfg,
-                                       partition_bytes=partition_bytes,
-                                       partition_align=8))
+        overrides.update(partition_bytes=partition_bytes,
+                         partition_align=8)
+    if hierarchical:
+        # slice every smoke tensor into 4 name@s{r} sub-tensors (the
+        # min-bytes floor is dropped so the tiny tensors are eligible)
+        overrides.update(hierarchical=True, hierarchical_min_bytes=1,
+                         local_size=4)
+    if overrides:
+        set_config(dataclasses.replace(saved_cfg, **overrides))
     try:
         return _run(steps, seed, rate, dim, verbose, compression, window,
                     transport, kill_shard_at,
@@ -198,9 +212,13 @@ def _run(steps, seed, rate, dim, verbose, compression, window,
             "shard 1 was killed but failover never fired — the run "
             "proved nothing about degraded mode")
     if verbose:
+        from byteps_tpu.common.config import get_config as _gc
+
         mode = f" [compression={compression}]" if compression else ""
         if transport:
             mode += f" [transport={transport}]"
+        if _gc().hierarchical:
+            mode += f" [hierarchical x{_gc().local_size}]"
         print(f"chaos smoke OK{mode}: {steps} steps x {len(names)} "
               f"tensors, {stats['faults']}/{stats['requests']} requests "
               f"faulted, bit-for-bit parameter match")
@@ -231,12 +249,18 @@ def main() -> int:
     ap.add_argument("--kill-shard-at", type=int, default=None,
                     help="hard-kill shard 1 after this chaos step so "
                          "failover deterministically fires")
+    ap.add_argument("--hierarchical", action="store_true",
+                    help="slice every tensor into name@s{r} sub-tensors "
+                         "(local_size 4) so the exactly-once bar runs "
+                         "per slice (docs/wire.md 'Hierarchical "
+                         "reduction')")
     ap.add_argument("--dim", type=int, default=16)
     args = ap.parse_args()
     run(steps=args.steps, seed=args.seed, rate=args.rate,
         compression=args.compression, window=args.window,
         partition_bytes=args.partition_bytes, dim=args.dim,
-        transport=args.transport, kill_shard_at=args.kill_shard_at)
+        transport=args.transport, kill_shard_at=args.kill_shard_at,
+        hierarchical=args.hierarchical)
     return 0
 
 

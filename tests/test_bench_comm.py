@@ -45,3 +45,26 @@ def test_bench_comm_transport_ab_meets_bar():
     with open(os.path.join(REPO, "BENCH_COMM.json")) as f:
         archived = {r["metric"] for r in json.load(f)["rows"]}
     assert "wire_transport_pull_shm_1mb_ms" in archived
+
+
+@pytest.mark.slow
+def test_bench_comm_hierarchical_ab_meets_bar():
+    """ISSUE 8 acceptance: with hierarchical push/pull on, mutation
+    wire bytes per step drop by >= 0.9 x local_size on the emulated
+    local mesh (4 workers), and the rows are archived."""
+    proc = subprocess.run(
+        [sys.executable, "bench_comm.py", "--hierarchical"],
+        cwd=REPO, capture_output=True, text=True, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = [json.loads(line) for line in proc.stdout.splitlines()
+            if line.startswith("{")]
+    row = next(r for r in rows
+               if r["metric"] == "hierarchical_wire_bytes_per_step")
+    assert row["byte_reduction_x"] >= 0.9 * row["local_size"], row
+    # the local reduction must not make the wall clock WORSE on a
+    # latency-dominated wire (it sends 1/local_size the bytes)
+    assert row["speedup_min"] >= 0.9, row
+    with open(os.path.join(REPO, "BENCH_COMM.json")) as f:
+        archived = {r["metric"] for r in json.load(f)["rows"]}
+    assert "hierarchical_wire_bytes_per_step" in archived

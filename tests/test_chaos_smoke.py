@@ -68,6 +68,28 @@ def test_chaos_smoke_uds_transport_exactly_once_with_failover():
 
 
 @pytest.mark.slow
+def test_chaos_smoke_hierarchical_sliced_exactly_once_with_failover():
+    """ISSUE 8 acceptance (docs/wire.md "Hierarchical reduction"): the
+    full chaos bar with hierarchical slicing on — every tensor travels
+    as 4 ``name@s{r}`` sub-tensors (each further partitioned), under a
+    27% fault rate with the pipelined window AND a deterministic mid-run
+    shard kill.  Bit-for-bit clean-vs-chaos proves the per-slice version
+    guards, per-slice EF commits and per-slice failover re-seeds are
+    exactly-once in any completion order."""
+    import chaos_smoke
+
+    stats = chaos_smoke.run(steps=40, seed=1, rate=0.27, verbose=False,
+                            compression="randomk", window=8,
+                            partition_bytes=24, dim=64,
+                            hierarchical=True, kill_shard_at=30)
+    assert stats["faults"] > 0
+    assert stats["faults"] / stats["requests"] >= 0.05
+    assert stats.get("resilience.window_abort", 0) > 0
+    assert stats.get("resilience.retry_dedup", 0) > 0
+    assert stats.get("resilience.failover", 0) >= 1
+
+
+@pytest.mark.slow
 def test_chaos_smoke_pipelined_partitioned_exactly_once():
     """PR 4 acceptance (docs/wire.md): the pipelined wire client —
     in-flight window, partitioned tensors fanned out across shards,

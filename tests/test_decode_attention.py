@@ -118,6 +118,7 @@ def test_int8_matches_grouped_q8_path(H, KV, pos):
                                rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow  # ~10s: two full generates (tier-1 duration budget); int8_matches_grouped_q8_path/window/tail-chunk parity stays fast
 def test_flat_int8_generate_matches_grouped_int8():
     """End to end: generate() on a flat int8 cache (layout='flat',
     kv_quant) produces the same tokens as the grouped int8 cache — the

@@ -61,6 +61,7 @@ def test_incremental_decode_matches_forward():
         np.asarray(got), np.asarray(full), rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow  # ~16s: token-by-token reference loop (tier-1 duration budget); incremental_decode/prefill/windowed parity stay fast
 def test_greedy_generate_matches_reference_loop():
     """The scan-based generate equals a naive loop that re-runs the full
     forward on the growing sequence each step."""

@@ -33,6 +33,7 @@ def _hf_model(n_layer=2, n_head=2, n_embd=32, vocab=97, n_positions=64,
     return transformers.GPT2LMHeadModel(cfg).eval()
 
 
+@pytest.mark.slow  # ~12s: HF torch forward (tier-1 duration budget); inference_stack_on_gpt2 + gpt2_arch_trains stay fast, llama keeps a fast torch-logits parity
 def test_logits_match_torch():
     hf = _hf_model()
     model, variables = load_gpt2(hf)

@@ -8,6 +8,7 @@ import pytest
 from byteps_tpu.models import ResNet18, ResNet50, VGG11, Transformer, TransformerConfig
 
 
+@pytest.mark.slow  # ~14s: full ResNet-50 compile (tier-1 duration budget); resnet_train_mode_updates_stats keeps fast resnet coverage
 def test_resnet50_forward_shapes():
     model = ResNet50(num_classes=10, num_filters=8)
     x = jnp.zeros((2, 64, 64, 3))
@@ -117,6 +118,7 @@ def test_mobilenet_v2_forward_and_train_step():
     assert np.isfinite(float(metrics["loss"]))
 
 
+@pytest.mark.slow  # ~9s (tier-1 duration budget); vgg/transformer forwards keep fast classic-model coverage
 def test_lenet_alexnet_forward():
     from byteps_tpu.models import AlexNet, LeNet
 

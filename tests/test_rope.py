@@ -90,6 +90,7 @@ def test_rope_generate_matches_naive_and_int8_cache():
                                   np.asarray(out["tokens"]))
 
 
+@pytest.mark.slow  # ~11s: full train-step compile (tier-1 duration budget); rope decode/generate/flash/ring parity stays fast
 def test_rope_swiglu_train_step_decreases_loss():
     import optax
 
