@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import threading
 import time
 
 from bench_util import archive_rows
@@ -288,6 +289,133 @@ def prefix_share(requests: int = 12, shared_len: int = 96,
     return row
 
 
+def paged_ab(long_reqs: int = 2, long_len: int = 160,
+             short_reqs: int = 14, short_len: int = 16,
+             tokens: int = 16, slots: int = 16, dense_slots: int = 4,
+             d_model: int = 256, layers: int = 2, vocab: int = 256,
+             block: int = 16, chunk: int = 32, max_seq: int = 256,
+             out_path: str = "BENCH_SERVE.json", archive: bool = True):
+    """Paged-vs-dense A/B at a FIXED KV-memory budget on a mixed
+    long/short workload (the PagedAttention acceptance leg).
+
+    Both engines get the same KV bytes: ``dense_slots`` full
+    ``max_seq`` rows.  The dense engine can therefore hold only
+    ``dense_slots`` requests at once — worst-case length bounds its
+    concurrency even though the mixed workload's ACTUAL usage is a
+    fraction of it.  The paged engine spends the same bytes as a block
+    pool and runs ``slots`` slots over it, so admission is bounded by
+    usage.  Reported: peak concurrent in-flight requests per engine
+    (the >= 2x acceptance bar), wall-clock for the whole workload,
+    TTFT p50, and a uniform all-short leg where both engines are
+    unconstrained — paged TTFT/TPOT must sit within host noise of
+    dense there (the gather adds a copy, not an algorithm change).
+    Token parity between the two engines is asserted bit-for-bit."""
+    cfg = TransformerConfig(
+        vocab_size=vocab, num_layers=layers, num_heads=4,
+        d_model=d_model, d_ff=4 * d_model, max_seq_len=max_seq,
+        dtype=jnp.float32)
+    model = Transformer(cfg)
+    variables = model.init(jax.random.PRNGKey(1),
+                           jnp.zeros((1, 8), jnp.int32))
+    longs = _prompts(long_reqs, long_len, vocab)
+    shorts = _prompts(short_reqs + 2, short_len, vocab)
+    # interleave: long prompts arrive mid-stream, not as a head batch
+    mixed = shorts[:short_reqs // 2] + longs + shorts[short_reqs // 2:
+                                                     short_reqs]
+    # one block's bytes across all layers' k+v (f32, 4 kv heads)
+    block_bytes = layers * 2 * block * 4 * (d_model // 4) * 4
+
+    def run_engine(prompts, paged, n_slots, kv_blocks=None):
+        eng = ServingEngine(
+            model, variables, n_slots=n_slots, max_seq=max_seq,
+            temperature=0.0, max_queue=4 * len(prompts), chunk=chunk,
+            paged=paged, block=block, kv_blocks=kv_blocks,
+            metrics=ServeMetrics())
+        eng.start()
+        eng.submit(shorts[-1], tokens)  # warmup: compile off-timer
+        eng.drain(timeout=600)
+        eng.submit(longs[0], tokens)    # (long bucket chain too)
+        eng.drain(timeout=600)
+        eng.metrics = ServeMetrics()
+        peak = {"v": 0}
+        stop = threading.Event()
+
+        def sample():
+            while not stop.is_set():
+                peak["v"] = max(peak["v"], eng.pool.active_count)
+                time.sleep(0.002)
+
+        t = threading.Thread(target=sample, daemon=True)
+        t.start()
+        t0 = time.perf_counter()
+        reqs = [eng.submit(p, tokens) for p in prompts]
+        eng.drain(timeout=600)
+        elapsed = time.perf_counter() - t0
+        stop.set()
+        t.join()
+        outs = [np.asarray(r.result()) for r in reqs]
+        summ = eng.metrics.summary()
+        counts = eng.compile_counts()
+        eng.stop()
+        if counts["decode"] != 1:
+            raise RuntimeError(f"decode retraced: {counts}")
+        return {"elapsed_s": round(elapsed, 4),
+                "peak_concurrent": peak["v"],
+                "ttft_p50_ms": round(summ["ttft_p50_s"] * 1e3, 2),
+                "tpot_p50_ms": round(summ["tpot_p50_s"] * 1e3, 2),
+                "outs": outs, "compile_counts": dict(counts)}
+
+    # same bytes: dense_slots rows' worth of blocks (+ the null block)
+    paged_blocks = dense_slots * (max_seq // block) + 1
+    dense_mixed = run_engine(mixed, paged=False, n_slots=dense_slots)
+    paged_mixed = run_engine(mixed, paged=True, n_slots=slots,
+                             kv_blocks=paged_blocks)
+    mismatches = sum(
+        0 if np.array_equal(a, b) else 1
+        for a, b in zip(dense_mixed["outs"], paged_mixed["outs"]))
+    # uniform all-short leg, both engines unconstrained: the paged
+    # gather must cost noise, not throughput
+    uniform = shorts[:short_reqs]
+    dense_uni = run_engine(uniform, paged=False, n_slots=slots)
+    paged_uni = run_engine(uniform, paged=True, n_slots=slots)
+    mismatches += sum(
+        0 if np.array_equal(a, b) else 1
+        for a, b in zip(dense_uni["outs"], paged_uni["outs"]))
+    row = {
+        "metric": "serve_paged_mixed",
+        "backend": jax.default_backend(),
+        "model": {"d_model": d_model, "layers": layers, "vocab": vocab,
+                  "max_seq": max_seq, "block": block, "chunk": chunk},
+        "kv_budget_bytes": paged_blocks * block_bytes,
+        "requests": len(mixed), "long_reqs": long_reqs,
+        "long_len": long_len, "short_len": short_len,
+        "tokens_per_request": tokens,
+        "dense_slots": dense_slots, "paged_slots": slots,
+        "dense_peak_concurrent": dense_mixed["peak_concurrent"],
+        "paged_peak_concurrent": paged_mixed["peak_concurrent"],
+        "concurrency_ratio": round(
+            paged_mixed["peak_concurrent"]
+            / max(dense_mixed["peak_concurrent"], 1), 2),
+        "dense_elapsed_s": dense_mixed["elapsed_s"],
+        "paged_elapsed_s": paged_mixed["elapsed_s"],
+        "dense_ttft_p50_ms": dense_mixed["ttft_p50_ms"],
+        "paged_ttft_p50_ms": paged_mixed["ttft_p50_ms"],
+        "uniform_dense_ttft_p50_ms": dense_uni["ttft_p50_ms"],
+        "uniform_paged_ttft_p50_ms": paged_uni["ttft_p50_ms"],
+        "uniform_dense_tpot_p50_ms": dense_uni["tpot_p50_ms"],
+        "uniform_paged_tpot_p50_ms": paged_uni["tpot_p50_ms"],
+        "mismatches": mismatches,
+        "compile_counts_paged": paged_mixed["compile_counts"],
+    }
+    print(json.dumps(row))
+    if mismatches:
+        raise RuntimeError(
+            f"paged engine broke token parity: {mismatches} mismatches")
+    if archive:
+        _archive_rows([row], out_path)
+    return row
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tokens", type=int, default=None,
@@ -305,6 +433,10 @@ def main(argv=None) -> int:
     ap.add_argument("--prefix-share", action="store_true",
                     help="run only the shared-system-prompt prefix-"
                          "cache A/B")
+    ap.add_argument("--paged", action="store_true",
+                    help="run only the paged-vs-dense A/B at a fixed "
+                         "KV-memory budget (mixed long/short workload "
+                         "+ uniform TTFT/TPOT noise check)")
     ap.add_argument("--shared-len", type=int, default=96)
     ap.add_argument("--requests", type=int, default=12)
     ap.add_argument("--chunk", type=int, default=32)
@@ -313,9 +445,21 @@ def main(argv=None) -> int:
     # the two legs have different sweet-spot defaults; explicit flags
     # win in both
     tokens = args.tokens if args.tokens is not None else (
-        16 if args.prefix_share else 64)
+        16 if args.prefix_share or args.paged else 64)
     slots = args.slots if args.slots is not None else (
         8 if args.prefix_share else 16)
+    if args.paged:
+        row = paged_ab(tokens=tokens, slots=slots,
+                       out_path=args.out, archive=not args.no_archive)
+        ratio = row["concurrency_ratio"]
+        ok = ratio >= 2.0 and row["mismatches"] == 0
+        print(f"paged @ fixed KV budget: {row['paged_peak_concurrent']}"
+              f" vs {row['dense_peak_concurrent']} concurrent "
+              f"({ratio}x), elapsed {row['paged_elapsed_s']}s vs "
+              f"{row['dense_elapsed_s']}s "
+              f"({'PASS' if ok else 'FAIL'} >= 2x concurrency, exact "
+              f"parity)")
+        return 0 if ok else 1
     if args.prefix_share:
         row = prefix_share(requests=args.requests,
                            shared_len=args.shared_len,

@@ -159,6 +159,9 @@ class Config:
     serve_prefix_cache: bool = False  # prefix-reuse KV cache
     serve_prefix_block: int = 16  # prefix match granularity (tokens)
     serve_prefix_mb: int = 256    # prefix store byte budget (MiB); 0 = inf
+    serve_paged: bool = False     # paged KV cache (block-granular pool)
+    serve_block: int = 16         # KV block size in tokens (paged)
+    serve_kv_mb: int = 0          # paged KV pool budget (MiB); 0 = dense-equiv
 
     # --- pipelined wire engine (byteps_tpu/engine/wire.py; the client
     # half of the push/pull pipelining BytePS keeps the wire busy with —
@@ -267,6 +270,9 @@ class Config:
             serve_prefix_cache=_env_bool("BYTEPS_SERVE_PREFIX_CACHE"),
             serve_prefix_block=_env_int("BYTEPS_SERVE_PREFIX_BLOCK", 16),
             serve_prefix_mb=_env_int("BYTEPS_SERVE_PREFIX_MB", 256),
+            serve_paged=_env_bool("BYTEPS_SERVE_PAGED"),
+            serve_block=_env_int("BYTEPS_SERVE_BLOCK", 16),
+            serve_kv_mb=_env_int("BYTEPS_SERVE_KV_MB", 0),
             wire_window=_env_int("BYTEPS_WIRE_WINDOW", 8),
             wire_fanout=_env_int("BYTEPS_WIRE_FANOUT", 16),
             transport=_env_str("BYTEPS_TRANSPORT", "auto"),

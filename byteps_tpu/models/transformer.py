@@ -848,6 +848,58 @@ class Transformer(nn.Module):
         """
         return self.decode(tokens, caches, pos, last_idx=last_idx)
 
+    def decode_paged(self, tokens, pcaches, table, pos, last_only=False,
+                     last_idx=None):
+        """`decode` against a **paged** KV cache: one slot's contiguous
+        cache rows are gathered from the per-layer block pools
+        (``pcaches``: ``[n_blocks, block, ...]`` per layer) via the
+        slot's block table (``table [max_blocks]`` int32, unallocated
+        entries pointing at the null block), then the ordinary dense
+        cached decode runs on the gathered ``[1, max_seq, ...]`` row.
+
+        The gather moves stored bytes; it computes nothing — so this
+        path is bit-exact against the contiguous cache by construction
+        (one attention implementation, serving/blocks.py).  Returns
+        ``(logits, new_rows)`` where ``new_rows`` are the gathered rows
+        with this step's K/V written at ``[pos, pos + tq)``; the caller
+        (the serving engine's jitted decode step) slices the written
+        span back out and scatters it into the block pool.
+        """
+        rows = gather_paged_rows(pcaches, table)
+        return self.decode(tokens, rows, pos, last_only=last_only,
+                           last_idx=last_idx)
+
+    def prefill_chunk_paged(self, tokens, pcaches, table, pos, last_idx):
+        """``prefill_chunk`` over a paged cache: gather the slot's rows
+        through its block table, run the position-offset chunk, return
+        the written rows for the caller's scatter-back (see
+        :meth:`decode_paged`)."""
+        rows = gather_paged_rows(pcaches, table)
+        return self.prefill_chunk(tokens, rows, pos, last_idx)
+
+
+def gather_paged_rows(pcaches, table):
+    """Assemble one slot's contiguous cache view from paged per-layer
+    block pools: ``c [n_blocks, block, ...]`` indexed by the slot's
+    block table ``[max_blocks]`` -> ``[1, max_blocks * block, ...]``.
+
+    Positions past the slot's write cursor gather arbitrary bytes (the
+    null block, or a stale block's content) — exactly the dense pool's
+    stale-rows situation, and safe for the same reason: the causal mask
+    admits only positions below the cursor, and masked scores
+    contribute exactly-zero probability mass (serving/slots.py).  The
+    serving engine enforces ``max_blocks * block == max_seq`` so the
+    gathered row is shape-identical to a dense cache row."""
+    out = []
+    for layer in pcaches:
+        row = {}
+        for name, c in layer.items():
+            g = c[table]  # [max_blocks, block, ...]
+            row[name] = g.reshape(
+                (1, g.shape[0] * g.shape[1]) + g.shape[2:])
+        out.append(row)
+    return tuple(out)
+
 
 def init_cache(cfg: TransformerConfig, batch_size: int, max_len: int,
                quantized: bool = False, layout: str = "auto"):

@@ -8,6 +8,12 @@ kernels into a *serving engine*:
   * ``slots`` — a fixed-capacity KV-cache slot pool built on
     ``models.transformer.init_cache`` (N slots x max_seq padded cache),
     so admitting a request is a cache-row write, not a recompile;
+  * ``blocks`` — the paged alternative (``paged=True``): KV memory as
+    a pool of fixed-size blocks with per-slot block tables, lazy block
+    grants, copy-on-write forks, and preemption under pressure —
+    actual usage, not worst-case ``max_seq``, bounds concurrency, and
+    a prefix-cache hit shares refcounted blocks instead of copying
+    rows (PagedAttention / RadixAttention unified);
   * ``scheduler`` — credit-scheduled admission reusing the semantics of
     ``common/scheduler.py:ScheduledQueue``: prefill (large, bursty)
     interleaves against decode (small, latency-critical) under a token
@@ -33,10 +39,17 @@ output is token-identical to sequential ``generate()`` per request —
 see docs/serving.md.
 """
 
+from .blocks import (  # noqa: F401
+    BlockAllocator,
+    BlocksExhaustedError,
+    BlockTable,
+    PagedSlotPool,
+)
 from .engine import Request, RequestState, ServingEngine  # noqa: F401
 from .frontend import ServeClient, serve, serve_from_env  # noqa: F401
 from .metrics import ServeMetrics, get_serve_metrics  # noqa: F401
 from .prefix import (  # noqa: F401
+    PagedPrefixCache,
     PrefixCache,
     PrefixEntry,
     weights_fingerprint,

@@ -1,0 +1,393 @@
+"""Paged KV cache: block-granular slot memory (PagedAttention-style).
+
+The slot pool (``slots.py``) reserves a full ``max_seq`` cache row per
+admitted request, so *worst-case* length — not actual usage — bounds
+concurrency: a pool sized for 4 rows of 2048 tokens cannot hold 16
+requests that each use 100, even though the bytes are there.  This
+module repages that memory into fixed-size **KV blocks** (vLLM's
+PagedAttention idea, Kwon et al. 2023):
+
+  * one cache pytree per layer shaped ``[n_blocks, block, kv_heads,
+    d_head]`` — a pool of interchangeable physical blocks instead of
+    per-slot rows;
+  * a :class:`BlockAllocator` — lowest-index free list (deterministic,
+    like slot assignment) plus per-block **refcounts**, so a physical
+    block can back several logical tables at once (shared prefixes);
+  * a per-slot :class:`BlockTable` mapping logical block index
+    ``pos // block`` to a physical block id.  The engine grants blocks
+    *lazily* as a request's cursor crosses block boundaries, so a
+    request only ever holds ``ceil(used / block)`` blocks.
+
+Attention reads gather the table's blocks back into a contiguous
+``[1, max_seq, ...]`` row (``models.transformer.gather_paged_rows``)
+and run the SAME dense cached-attention program the contiguous engine
+runs — the gather moves bytes, it computes nothing, so paged-on vs
+paged-off is bit-exact by construction (docs/serving.md "Paged KV
+cache").  ``max_seq % block == 0`` is enforced so the gathered row is
+exactly ``max_seq`` wide: the attention program is shape-identical to
+the dense engine's, not merely value-identical.
+
+**The null block.**  Physical block 0 is allocated at pool construction
+and never freed: it is the scatter target for every masked slot's
+garbage decode write and the gather source for table entries past a
+slot's allocated prefix.  Its content is arbitrary and never attended
+(the causal mask admits only positions below a slot's own cursor), so
+writes to it need no coordination — the paged twin of the dense pool's
+freed-rows-are-never-zeroed argument (slots.py).
+
+Refcount discipline:
+
+  * a block with ``refs == 1`` is privately owned and writable;
+  * ``refs >= 2`` means shared (a prefix-cache entry and/or other
+    slots) — writers must **copy-on-write fork** first
+    (:meth:`BlockTable.cow`), the engine pays one device-side block
+    copy and the table points at the private clone;
+  * ``decref`` to zero returns the block to the free list.  Prefix
+    eviction therefore *cannot* free a block a live slot still maps —
+    it only drops the store's reference.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.transformer import TransformerConfig
+from .scheduler import AdmissionError
+from .slots import SlotPool
+
+__all__ = ["BlocksExhaustedError", "BlockAllocator", "BlockTable",
+           "init_paged_cache", "PagedSlotPool"]
+
+
+class BlocksExhaustedError(AdmissionError):
+    """KV block pool exhausted — typed backpressure.  The engine reacts
+    by evicting unpinned prefix entries, then preempting the newest
+    in-flight request back to QUEUED; a request that cannot fit the
+    pool even alone fails with this error attached."""
+
+    def __init__(self, needed: int, free: int):
+        self.needed = needed
+        self.free = free
+        super().__init__(
+            f"KV block pool exhausted: need {needed} block(s), {free} "
+            f"free; raise BYTEPS_SERVE_KV_MB or lower concurrency")
+
+
+class BlockAllocator:
+    """Free-list + refcount bookkeeping over ``n_blocks`` physical KV
+    blocks of ``block`` tokens each.  Pure host-side Python — the
+    device arrays live in the pool; this class only decides which ids
+    are free, owned, or shared.  Lowest-free-id allocation keeps the
+    engine's tick order (and so its output) deterministic, mirroring
+    the slot pool's lowest-free-index rule."""
+
+    def __init__(self, n_blocks: int, block: int):
+        if n_blocks < 1:
+            raise ValueError(f"n_blocks must be >= 1, got {n_blocks}")
+        if block < 1:
+            raise ValueError(f"block must be >= 1, got {block}")
+        self.n_blocks = n_blocks
+        self.block = block
+        self._free: List[int] = list(range(n_blocks))
+        heapq.heapify(self._free)
+        self._refs: List[int] = [0] * n_blocks
+        self._lock = threading.Lock()
+
+    def alloc(self, n: int = 1) -> List[int]:
+        """Claim ``n`` blocks (refs start at 1).  Atomic: on
+        :class:`BlocksExhaustedError` nothing was allocated."""
+        if n < 0:
+            raise ValueError(f"alloc count must be >= 0, got {n}")
+        with self._lock:
+            if n > len(self._free):
+                raise BlocksExhaustedError(n, len(self._free))
+            out = [heapq.heappop(self._free) for _ in range(n)]
+            for bid in out:
+                self._refs[bid] = 1
+            return out
+
+    def incref(self, bid: int) -> int:
+        """Add a reference to an allocated block (sharing)."""
+        with self._lock:
+            if self._refs[bid] < 1:
+                raise ValueError(f"incref on free block {bid}")
+            self._refs[bid] += 1
+            return self._refs[bid]
+
+    def decref(self, bid: int) -> int:
+        """Drop a reference; at zero the block returns to the free
+        list.  Returns the remaining count."""
+        with self._lock:
+            if self._refs[bid] < 1:
+                raise ValueError(f"decref on free block {bid}")
+            self._refs[bid] -= 1
+            if self._refs[bid] == 0:
+                heapq.heappush(self._free, bid)
+            return self._refs[bid]
+
+    def refs(self, bid: int) -> int:
+        with self._lock:
+            return self._refs[bid]
+
+    @property
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return self.n_blocks - self.free_count
+
+    def shared_count(self) -> int:
+        """Blocks referenced by more than one holder (prefix sharing)."""
+        with self._lock:
+            return sum(1 for r in self._refs if r >= 2)
+
+
+class BlockTable:
+    """One slot's logical->physical block mapping: entry ``i`` backs
+    token positions ``[i * block, (i + 1) * block)``.  Grows lazily
+    (``ensure``), can adopt shared blocks at its head (``share``), and
+    forks shared entries copy-on-write before a write (``cow``)."""
+
+    __slots__ = ("blocks", "max_blocks")
+
+    def __init__(self, max_blocks: int):
+        self.blocks: List[int] = []
+        self.max_blocks = max_blocks
+
+    def __len__(self) -> int:
+        return len(self.blocks)
+
+    def ensure(self, alloc: BlockAllocator, n_logical: int) -> List[int]:
+        """Grow the table to cover ``n_logical`` blocks; returns the
+        freshly allocated ids (empty when already covered).  Atomic:
+        on exhaustion the table is unchanged."""
+        if n_logical > self.max_blocks:
+            raise ValueError(
+                f"table overflow: need {n_logical} logical blocks, "
+                f"max {self.max_blocks}")
+        missing = n_logical - len(self.blocks)
+        if missing <= 0:
+            return []
+        fresh = alloc.alloc(missing)
+        self.blocks.extend(fresh)
+        return fresh
+
+    def share(self, alloc: BlockAllocator, ids: Sequence[int]) -> None:
+        """Adopt ``ids`` as this table's head (a prefix-cache hit):
+        each gains a reference.  Only valid on an empty table — shared
+        prefixes are attached at admission, before any writes."""
+        if self.blocks:
+            raise ValueError("share() on a non-empty block table")
+        for bid in ids:
+            alloc.incref(bid)
+        self.blocks.extend(ids)
+
+    def cow(self, alloc: BlockAllocator,
+            idx: int) -> Optional[Tuple[int, int]]:
+        """Copy-on-write fork of entry ``idx`` when it is shared:
+        allocates a private clone, swaps it in, and drops the shared
+        reference.  Returns ``(old_id, new_id)`` for the caller's
+        device-side copy, or None when the entry was already private.
+        On exhaustion the table is unchanged (alloc happens first)."""
+        bid = self.blocks[idx]
+        if alloc.refs(bid) <= 1:
+            return None
+        new = alloc.alloc(1)[0]
+        self.blocks[idx] = new
+        alloc.decref(bid)
+        return bid, new
+
+    def release(self, alloc: BlockAllocator) -> None:
+        """Drop every reference this table holds (slot free /
+        preemption).  Shared blocks survive under their other refs."""
+        for bid in self.blocks:
+            alloc.decref(bid)
+        self.blocks.clear()
+
+
+def init_paged_cache(cfg: TransformerConfig, n_blocks: int, block: int):
+    """Zeroed paged KV cache: per layer ``{"k","v"}`` of shape
+    ``[n_blocks, block, kv_heads, d_head]``.  The grouped (dense
+    mixed-dot) layout only — the paged engine's gathered rows feed the
+    same ``_cached_attention`` the contiguous grouped cache feeds; the
+    flat Pallas layout has no head axis to page and the int8 cache
+    reads quantized values at traced positions (both refused upstream,
+    ``ServingEngine``)."""
+    KV, D = cfg.kv_heads, cfg.d_head
+    shape = (n_blocks, block, KV, D)
+    return tuple(
+        {"k": jnp.zeros(shape, cfg.dtype),
+         "v": jnp.zeros(shape, cfg.dtype)}
+        for _ in range(cfg.num_layers)
+    )
+
+
+class PagedSlotPool(SlotPool):
+    """Slot pool whose KV storage is a shared pool of fixed-size blocks
+    instead of per-slot ``max_seq`` rows.
+
+    Slot bookkeeping (assign/free/advance, cursors, request ids) is
+    inherited unchanged — a slot is still the unit of *decode batch
+    membership*.  What changes is memory: ``n_blocks`` bounds the
+    pool's bytes independently of ``n_slots * max_seq``, so short
+    requests stop paying for worst-case rows and ``n_slots`` can be
+    sized to target *concurrency* while ``kv_bytes`` sizes *memory*.
+
+    Sizing: ``n_blocks`` explicit, or derived from ``kv_bytes``
+    (``BYTEPS_SERVE_KV_MB``), or — default — the dense-equivalent
+    ``n_slots * max_seq / block`` plus the null block, which makes a
+    knob-free paged engine hold exactly what the dense engine holds.
+    """
+
+    def __init__(self, cfg: TransformerConfig, n_slots: int, max_seq: int,
+                 *, block: int = 16, n_blocks: Optional[int] = None,
+                 kv_bytes: int = 0, kv_quant: bool = False,
+                 layout: str = "grouped"):
+        if kv_quant:
+            raise ValueError(
+                "paged KV cache requires a dense cache (kv_quant=False):"
+                " gathered rows are attended at traced positions, which"
+                " under int8 reads already-quantized K/V and breaks the"
+                " bit-exact parity contract")
+        if layout not in ("grouped", "auto"):
+            raise ValueError(
+                f'paged KV cache supports layout="grouped" only (the '
+                f'flat stream has no block structure to page), got '
+                f'{layout!r}')
+        if block < 1:
+            raise ValueError(f"block must be >= 1, got {block}")
+        if max_seq % block:
+            raise ValueError(
+                f"max_seq {max_seq} must be a multiple of the KV block "
+                f"size {block}: the gathered row must be exactly "
+                f"max_seq wide so the paged attention program is "
+                f"shape-identical to the dense engine's")
+        self.block = block
+        self.max_blocks = max_seq // block
+        KV, D = cfg.kv_heads, cfg.d_head
+        itemsize = jnp.dtype(cfg.dtype).itemsize
+        # bytes of ONE physical block across every layer's k+v arrays —
+        # the honest unit for budget math and prefix-store accounting
+        self.block_bytes = cfg.num_layers * 2 * block * KV * D * itemsize
+        if n_blocks is None:
+            if kv_bytes > 0:
+                n_blocks = kv_bytes // self.block_bytes
+            else:
+                # dense-equivalent default (+1 for the null block)
+                n_blocks = n_slots * self.max_blocks + 1
+        # one max-length request + the null block is the floor below
+        # which even a lone request could never complete
+        if n_blocks < self.max_blocks + 1:
+            raise ValueError(
+                f"paged KV pool too small: {n_blocks} blocks "
+                f"({n_blocks * self.block_bytes} bytes) cannot hold one "
+                f"max_seq={max_seq} request ({self.max_blocks} blocks) "
+                f"plus the null block; raise BYTEPS_SERVE_KV_MB or "
+                f"lower max_seq")
+        self._n_blocks = n_blocks
+        super().__init__(cfg, n_slots, max_seq, kv_quant=False,
+                         layout="grouped")
+        self.alloc = BlockAllocator(n_blocks, block)
+        # physical block 0, allocated once and held forever: gather
+        # source for unallocated table entries and scatter sink for
+        # masked slots' garbage decode writes (module docstring)
+        self.null_block = self.alloc.alloc(1)[0]
+        self.tables: List[BlockTable] = [
+            BlockTable(self.max_blocks) for _ in range(n_slots)]
+        self._tables_dirty = True
+        self._tables_dev = None
+
+    def _init_caches(self):
+        return init_paged_cache(self.cfg, self._n_blocks, self.block)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def reset_locked(self, slot: int) -> None:
+        super().reset_locked(slot)
+        self.tables[slot].release(self.alloc)
+        self._tables_dirty = True
+
+    # ------------------------------------------------------- block granting
+
+    def ensure_blocks(self, slot: int, upto_pos: int) -> List[int]:
+        """Lazily grant blocks so ``slot`` can write positions
+        ``[0, upto_pos)``; raises :class:`BlocksExhaustedError` (table
+        unchanged) when the pool cannot cover it."""
+        need = -(-upto_pos // self.block)
+        fresh = self.tables[slot].ensure(self.alloc, need)
+        if fresh:
+            self._tables_dirty = True
+        return fresh
+
+    def share_prefix(self, slot: int, ids: Sequence[int]) -> None:
+        """Attach a prefix-cache hit's blocks at the head of ``slot``'s
+        table — refcount bumps only, zero device-side copies."""
+        self.tables[slot].share(self.alloc, ids)
+        self._tables_dirty = True
+
+    def make_writable(self, slot: int, lo_pos: int, hi_pos: int,
+                      copy_cb) -> int:
+        """Copy-on-write fork of any *shared* block backing positions
+        ``[lo_pos, hi_pos)`` before a write lands there.  ``copy_cb(old,
+        new)`` performs the device-side block copy.  Returns the number
+        of forks (0 in the common case — writes normally land past the
+        shared prefix)."""
+        t = self.tables[slot]
+        forks = 0
+        last = min((hi_pos - 1) // self.block + 1, len(t.blocks))
+        for idx in range(lo_pos // self.block, last):
+            pair = t.cow(self.alloc, idx)
+            if pair is not None:
+                copy_cb(*pair)
+                forks += 1
+                self._tables_dirty = True
+        return forks
+
+    # ----------------------------------------------------------- device view
+
+    def write_target(self, slot: int) -> Tuple[int, int]:
+        """(physical block id, in-block offset) of the slot's next K/V
+        write — the decode step's scatter destination."""
+        pos = self.pos[slot]
+        return self.tables[slot].blocks[pos // self.block], \
+            pos % self.block
+
+    def tables_device(self):
+        """``[n_slots, max_blocks]`` int32 device array of every slot's
+        table, unallocated entries pointing at the null block.  Cached
+        and rebuilt only when some table changed."""
+        if self._tables_dirty or self._tables_dev is None:
+            arr = np.full((self.n_slots, self.max_blocks),
+                          self.null_block, np.int32)
+            for s, t in enumerate(self.tables):
+                if t.blocks:
+                    arr[s, :len(t.blocks)] = t.blocks
+            self._tables_dev = jnp.asarray(arr)
+            self._tables_dirty = False
+        return self._tables_dev
+
+    def table_row(self, slot: int):
+        """One slot's ``[max_blocks]`` int32 table (chunk-prefill arg)."""
+        row = np.full((self.max_blocks,), self.null_block, np.int32)
+        t = self.tables[slot].blocks
+        if t:
+            row[:len(t)] = t
+        return jnp.asarray(row)
+
+    # ---------------------------------------------------------- inspection
+
+    def block_stats(self) -> dict:
+        """Live pool accounting (the TCP STATS / metrics surface).
+        ``used`` includes the permanently held null block."""
+        return {"block": self.block, "n_blocks": self.alloc.n_blocks,
+                "block_bytes": self.block_bytes,
+                "free": self.alloc.free_count,
+                "used": self.alloc.used_count,
+                "shared": self.alloc.shared_count()}

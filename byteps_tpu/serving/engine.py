@@ -32,6 +32,15 @@ requests flow through:
     copy program (one trace — entries are full-row buffers), and
     prefill resumes at the boundary.  Bit-exact by construction: the
     K/V bytes are copied, not recomputed.
+  * **paged KV cache** (``paged=True``, serving/blocks.py) — slot
+    memory as fixed-size blocks with per-slot block tables: the decode
+    and chunk programs gather each slot's rows through its table and
+    scatter writes back to ``(table[pos // block], pos % block)``,
+    blocks are granted lazily at boundary crossings, a prefix hit
+    SHARES refcounted blocks (zero device copies — the copy/extract
+    programs are never built), and pool exhaustion evicts prefix
+    entries then preempts the newest request back to QUEUED (resume is
+    bit-exact; docs/serving.md "Paged KV cache").
 
 **Determinism / parity contract** (the correctness anchor, pinned by
 tests/test_serving.py and scripts/serve_smoke.py): per request, the
@@ -69,8 +78,9 @@ from ..common import logging as bps_log
 from ..inference import sample_logits
 from ..models.transformer import Transformer
 from . import metrics as sm
+from .blocks import BlocksExhaustedError, PagedSlotPool
 from .metrics import ServeMetrics, get_serve_metrics
-from .prefix import PrefixCache, weights_fingerprint
+from .prefix import PagedPrefixCache, PrefixCache, weights_fingerprint
 from .scheduler import ServeScheduler
 from .slots import SlotPool
 
@@ -106,6 +116,26 @@ class Request:
     slot: Optional[int] = None
     prefill_pos: int = 0  # prompt tokens already in the slot's K/V rows
     _pf_paid: bool = dataclasses.field(default=False, repr=False)
+    # the token sequence the current prefill covers: the prompt, or —
+    # after a preemption (paged engine, block pressure) — the prompt
+    # plus the already-emitted tokens minus the last one, whose K/V is
+    # rebuilt by re-prefill while the token itself stays the next
+    # decode input (docs/serving.md "Preemption")
+    _seq: Optional[np.ndarray] = dataclasses.field(
+        default=None, repr=False)
+    # preemption resume state: the last emitted token (next decode
+    # input) and the carried sampling key at preemption time — restored
+    # after the resume prefill so the per-request key chain continues
+    # exactly where it stopped (bit-exact seeded parity)
+    _resume_tok: Optional[int] = dataclasses.field(
+        default=None, repr=False)
+    _resume_key: Optional[np.ndarray] = dataclasses.field(
+        default=None, repr=False)
+    # anti-thrash watermark: a preempted request is re-admitted only
+    # once this many blocks are free (its worst-case remaining need) —
+    # eagerly re-admitting it would re-prefill, collide with the same
+    # pressure, and be preempted again every tick
+    _hold_blocks: int = dataclasses.field(default=0, repr=False)
     # rolling prefix-block digests, computed once at admit and reused
     # for the post-prefill insert (one blake2b per block per pass —
     # recomputing them three times per request sits on the tick thread)
@@ -218,6 +248,10 @@ class ServingEngine:
                  prefix_cache=False,
                  prefix_block: int = 16,
                  prefix_bytes: int = 256 << 20,
+                 paged: bool = False,
+                 block: int = 16,
+                 kv_mb: int = 0,
+                 kv_blocks: Optional[int] = None,
                  metrics: Optional[ServeMetrics] = None):
         self.model = model
         self.variables = variables
@@ -237,34 +271,28 @@ class ServingEngine:
         self.chunk = (_next_bucket(chunk, self.min_prefill_bucket,
                                    self.max_seq) if chunk and chunk > 0
                       else 0)
-        # prefix-reuse KV cache: True builds a private store, or pass a
-        # PrefixCache to share one across engines with IDENTICAL pool
-        # geometry (entries are full cache-row buffers).  Every key is
-        # salted with a fingerprint of THIS engine's weights, so
-        # engines serving different checkpoints through a shared store
-        # occupy disjoint key spaces — one model's K/V can never be
-        # copied into another model's slot
-        if isinstance(prefix_cache, PrefixCache):
-            self.prefix = prefix_cache
-        elif prefix_cache:
-            self.prefix = PrefixCache(block=prefix_block,
-                                      max_bytes=prefix_bytes)
-        else:
-            self.prefix = None
-        # chunk (and prefix-resumed) prefill attends at a TRACED
-        # position, which under kv_quant reads the already-quantized
-        # int8 K/V — whole-prompt prefill at static pos=0 reads the
-        # pre-quantization values instead (models/transformer.py dense
-        # fallback), so the combination would silently diverge from
-        # generate() and from a chunk=0 engine.  Refuse loudly.
-        if kv_quant and (self.chunk or self.prefix is not None):
+        # paged KV cache (serving/blocks.py): block-granular slot
+        # memory with zero-copy prefix sharing.  Every paged prefill
+        # runs through the position-offset chunk path (whole prompt as
+        # one chunk when chunk == 0) so ONE write discipline — gather,
+        # write the span, scatter the touched blocks back — covers all
+        # prefill, and the traced-position constraints below apply.
+        self.paged = bool(paged)
+        # chunk (and prefix-resumed, and every paged) prefill attends
+        # at a TRACED position, which under kv_quant reads the
+        # already-quantized int8 K/V — whole-prompt prefill at static
+        # pos=0 reads the pre-quantization values instead
+        # (models/transformer.py dense fallback), so the combination
+        # would silently diverge from generate() and from a chunk=0
+        # engine.  Refuse loudly.
+        if kv_quant and (self.chunk or prefix_cache or self.paged):
             raise ValueError(
-                "chunked prefill / prefix cache require a dense KV "
-                "cache: a chunk at a traced position attends int8 K/V "
-                "where whole-prompt prefill attends the "
-                "pre-quantization values, breaking the bit-exact "
-                "parity contract.  Run kv_quant engines with chunk=0 "
-                "and prefix_cache=False.")
+                "chunked prefill / prefix cache / paged KV cache "
+                "require a dense KV cache: a chunk at a traced "
+                "position attends int8 K/V where whole-prompt prefill "
+                "attends the pre-quantization values, breaking the "
+                "bit-exact parity contract.  Run kv_quant engines with "
+                "chunk=0, prefix_cache=False, paged=False.")
         # same hazard class for flash prefill: whole-prompt prefill at
         # static pos=0 can take the Pallas flash kernel (attn_impl=
         # "flash" + the gcd bucket gate), while a chunk at a traced
@@ -273,19 +301,65 @@ class ServingEngine:
         # diverge from generate().  max_seq < 128 can never produce a
         # flash-eligible bucket (the gate needs gcd(bucket, 1024) >=
         # 128 and buckets never exceed max_seq), so tiny configs pass.
-        if (self.chunk or self.prefix is not None) and (
+        if (self.chunk or prefix_cache or self.paged) and (
                 cfg.attn_impl == "flash" and not cfg.has_sp
                 and self.max_seq >= 128):
             raise ValueError(
-                "chunked prefill / prefix cache require the dense "
-                "prefill path: this config's whole-prompt prefill can "
-                "take the flash kernel while chunks always take dense "
-                "cached attention, and the two differ in accumulation "
-                "order — token streams could silently diverge from "
-                "generate().  Serve attn_impl='flash' models with "
-                "chunk=0 and prefix_cache=False.")
-        self.pool = SlotPool(cfg, n_slots, self.max_seq,
-                             kv_quant=kv_quant, layout=cache_layout)
+                "chunked prefill / prefix cache / paged KV cache "
+                "require the dense prefill path: this config's "
+                "whole-prompt prefill can take the flash kernel while "
+                "chunks always take dense cached attention, and the "
+                "two differ in accumulation order — token streams "
+                "could silently diverge from generate().  Serve "
+                "attn_impl='flash' models with chunk=0, "
+                "prefix_cache=False, paged=False.")
+        if self.paged:
+            self.pool = PagedSlotPool(
+                cfg, n_slots, self.max_seq, block=block,
+                n_blocks=kv_blocks, kv_bytes=kv_mb << 20,
+                kv_quant=kv_quant, layout=cache_layout)
+        else:
+            self.pool = SlotPool(cfg, n_slots, self.max_seq,
+                                 kv_quant=kv_quant, layout=cache_layout)
+        # prefix-reuse KV cache: True builds a private store, or pass a
+        # PrefixCache to share one across engines with IDENTICAL pool
+        # geometry (entries are full cache-row buffers).  Every key is
+        # salted with a fingerprint of THIS engine's weights, so
+        # engines serving different checkpoints through a shared store
+        # occupy disjoint key spaces — one model's K/V can never be
+        # copied into another model's slot.  A PAGED engine's store
+        # references its own block pool (entries are block-id lists, a
+        # hit is a refcount bump, not a copy — serving/prefix.py
+        # PagedPrefixCache), so it is always private: block ids are
+        # meaningless in any other engine's pool.
+        if self.paged and prefix_cache:
+            if isinstance(prefix_cache, PrefixCache):
+                raise ValueError(
+                    "a paged engine's prefix store references its own "
+                    "KV block pool (entries are block ids, not copied "
+                    "buffers) and cannot be shared across engines; "
+                    "pass prefix_cache=True")
+            self.prefix = PagedPrefixCache(
+                self.pool.alloc, block=self.pool.block,
+                block_bytes=self.pool.block_bytes,
+                max_bytes=prefix_bytes,
+                on_evict=lambda n: self.metrics.bump(
+                    sm.BLOCK_EVICTIONS, n))
+        elif isinstance(prefix_cache, PagedPrefixCache):
+            # the mirror refusal: a dense engine fed a paged store
+            # would call insert() (refused) or copy entry.buffer — a
+            # tuple of block ids, not a row pytree — into its cache
+            raise ValueError(
+                "a PagedPrefixCache references a paged engine's block "
+                "pool and cannot back a dense engine; pass "
+                "prefix_cache=True (or a plain PrefixCache)")
+        elif isinstance(prefix_cache, PrefixCache):
+            self.prefix = prefix_cache
+        elif prefix_cache:
+            self.prefix = PrefixCache(block=prefix_block,
+                                      max_bytes=prefix_bytes)
+        else:
+            self.prefix = None
         # every prefix entry is one full cache row, so its size is fixed
         # by the pool geometry; when even one can never fit the byte
         # budget, _maybe_insert_prefix skips the device-side extract
@@ -294,7 +368,7 @@ class ServingEngine:
         self._prefix_row_bytes = (sum(
             leaf.nbytes // n_slots
             for leaf in jax.tree_util.tree_leaves(self.pool.caches))
-            if self.prefix is not None else 0)
+            if self.prefix is not None and not self.paged else 0)
         # the store salt commits to the weights AND the per-slot cache
         # row geometry (shape past the slot dim, dtype): an engine with
         # a different max_seq / layout / kv_quant sharing the store
@@ -353,15 +427,20 @@ class ServingEngine:
         self.chunk_traces = 0
         self.prefix_copy_traces = 0
         self.prefix_extract_traces = 0
+        self.block_cow_traces = 0
         # donate the cache pool into each step: the pool is replaced by
         # the step's output, and without donation XLA would copy every
-        # layer's full [N, S, ...] cache per tick just to write one row
-        self._decode_step = jax.jit(self._make_decode_fn(),
-                                    donate_argnums=(1,))
+        # layer's full [N, S, ...] cache (or [n_blocks, block, ...]
+        # block pool) per tick just to write one row
+        self._decode_step = jax.jit(
+            self._make_paged_decode_fn() if self.paged
+            else self._make_decode_fn(),
+            donate_argnums=(1,))
         self._prefill_fns: Dict[int, object] = {}
         self._chunk_fns: Dict[int, object] = {}
         self._copy_fn = None
         self._extract_fn = None
+        self._cow_fn = None
 
     # ---------------------------------------------------- jitted programs
     #
@@ -425,6 +504,111 @@ class ServingEngine:
             return caches, nxt, keys2
 
         return decode_fn
+
+    def _make_paged_decode_fn(self):
+        """Paged twin of the decode step: per slot, gather the block
+        table's rows, run the SAME per-row decode (one attention
+        implementation — Transformer.decode_paged delegates to decode),
+        then scatter every slot's fresh K/V into the block pool at its
+        ``(write block, offset)`` target.  Masked slots (free or
+        PREFILLING) scatter into the null block, so their garbage write
+        can never touch a shared prefix block or a mid-prefill row —
+        simpler than the dense path's aim-at-the-cursor discipline."""
+        model, greedy = self.model, self.greedy
+        pad_id = self.pad_id
+        select = self._select_token
+
+        def one(variables, pcaches, table, tok, pos, key):
+            logits, new_rows = model.apply(
+                variables, tok[None, None], pcaches, table, pos,
+                method=Transformer.decode_paged)
+            nxt, nk = select(logits[:, -1], key)
+            # the one written position, sliced back out of the gathered
+            # row for the pool scatter below
+            fresh = tuple(
+                {n: jax.lax.dynamic_slice_in_dim(r[n], pos, 1,
+                                                 axis=1)[0, 0]
+                 for n in r} for r in new_rows)
+            return fresh, nxt, nk
+
+        def decode_fn(variables, pcaches, tok, pos, active, keys,
+                      tables, wblk, woff):
+            self.decode_traces += 1  # trace-time only
+            fresh, nxt, keys2 = jax.vmap(
+                one, in_axes=(None, None, 0, 0, 0, 0))(
+                    variables, pcaches, tables, tok, pos, keys)
+            nxt = jnp.where(active, nxt, pad_id)
+            if not greedy:
+                keys2 = jnp.where(active[:, None], keys2, keys)
+            else:
+                keys2 = keys
+            new_pc = tuple(
+                {n: pc[n].at[wblk, woff].set(fr[n]) for n in pc}
+                for pc, fr in zip(pcaches, fresh))
+            return new_pc, nxt, keys2
+
+        return decode_fn
+
+    def _paged_chunk_fn(self, bucket: int):
+        """Paged twin of ``_chunk_fn``: gather the slot's rows through
+        its block table, run the position-offset chunk, then scatter
+        the written span's blocks back into the pool.  The span covers
+        at most ``1 + ceil((bucket - 1) / block)`` consecutive logical
+        blocks (static count); the scatter writes exactly those —
+        out-of-range or untouched trailing entries write their own
+        unchanged bytes (or land on the null block), which is a no-op
+        by value, so shared blocks outside the span are never
+        altered."""
+        fn = self._chunk_fns.get(bucket)
+        if fn is not None:
+            return fn
+        model, select = self.model, self._select_token
+        blk = self.pool.block
+        mb = self.pool.max_blocks
+        null = self.pool.null_block
+        nb_touch = (bucket - 1) // blk + 2
+
+        def chunk_fn(variables, pcaches, tokens, table, start, last_idx,
+                     key):
+            self.chunk_traces += 1  # trace-time only
+            logits, new_rows = model.apply(
+                variables, tokens, pcaches, table, start, last_idx,
+                method=Transformer.prefill_chunk_paged)
+            tok0, nk = select(logits[:, -1], key)
+            first = start // blk
+            new_pc = []
+            for pc, nr in zip(pcaches, new_rows):
+                out = {}
+                for n, c in pc.items():
+                    for i in range(nb_touch):
+                        idx = first + i
+                        safe = jnp.minimum(idx, mb - 1)
+                        src = jax.lax.dynamic_slice_in_dim(
+                            nr[n], safe * blk, blk, axis=1)[0]
+                        bid = jnp.where(idx < mb, table[safe], null)
+                        c = c.at[bid].set(src)
+                    out[n] = c
+                new_pc.append(out)
+            return tuple(new_pc), tok0, nk
+
+        fn = jax.jit(chunk_fn, donate_argnums=(1,))
+        self._chunk_fns[bucket] = fn
+        return fn
+
+    def _cow_copy(self, src: int, dst: int) -> None:
+        """Device-side block copy backing a copy-on-write fork
+        (``PagedSlotPool.make_writable``): one compiled program for
+        every (src, dst) pair."""
+        if self._cow_fn is None:
+            def cow(pcaches, src, dst):
+                self.block_cow_traces += 1  # trace-time only
+                return tuple(
+                    {n: c[n].at[dst].set(c[n][src]) for n in c}
+                    for c in pcaches)
+
+            self._cow_fn = jax.jit(cow, donate_argnums=(0,))
+        self.pool.caches = self._cow_fn(self.pool.caches,
+                                        jnp.int32(src), jnp.int32(dst))
 
     def _prefill_fn(self, bucket: int):
         fn = self._prefill_fns.get(bucket)
@@ -568,14 +752,25 @@ class ServingEngine:
     def cancel(self, req: Request) -> None:
         """Request cancellation.  A still-QUEUED request is dropped from
         the admission queue immediately (it stops holding queue depth
-        and never consumes a grant); in-flight requests are retired on
-        the engine's next tick.  The eager drop races admission under
-        the engine lock: whichever side pops the task first wins, and
-        the grant-time cancelled check stays as the fallback."""
+        and never consumes a grant); the eager drop races admission
+        under the engine lock, and the grant-time cancelled check stays
+        as the fallback.  An in-flight (PREFILLING/DECODING) request is
+        retired eagerly too: ``cancel()`` serializes with ``step()``
+        on the engine lock, so no decode or chunk program is mid-
+        flight, and the slot — and in the paged engine its non-shared
+        KV blocks and prefix block references — returns to the pool
+        *now*, admissible by the very next tick rather than one tick
+        later.  The tick-start sweep remains as a belt-and-braces
+        fallback for the flag-only path."""
         req.cancelled = True
         with self._lock:
             if (req.state is RequestState.QUEUED and req._task is not None
                     and self.scheduler.remove(req._task)):
+                self._finish(req, RequestState.CANCELLED)
+            elif (req.state in (RequestState.PREFILLING,
+                                RequestState.ACTIVE)
+                    and req.slot is not None
+                    and self._engine_error is None):
                 self._finish(req, RequestState.CANCELLED)
         with self._wake:
             self._wake.notify_all()
@@ -590,6 +785,7 @@ class ServingEngine:
 
     def _step_locked(self) -> Dict[str, int]:
         emitted = 0
+        admitted = 0
         granted: List = []
         self._tick_chunk_debt = 0
         self._tick_prefill = 0
@@ -614,10 +810,30 @@ class ServingEngine:
             if free:
                 granted = self.scheduler.admit(free)
                 for task in granted:
-                    if task.request.cancelled:
-                        self._finish(task.request, RequestState.CANCELLED)
+                    req = task.request
+                    if req.cancelled:
+                        self._finish(req, RequestState.CANCELLED)
+                    elif (self.paged and req._hold_blocks
+                          and self.pool.alloc.free_count
+                          < req._hold_blocks
+                          and self.pool.active_count > 0):
+                        # preempted request waiting out block pressure:
+                        # stay QUEUED until its worst-case need fits
+                        # (others are still freeing); with nothing else
+                        # active it admits regardless — the pressure
+                        # path then evicts the prefix store or fails
+                        # loudly.  FCFS head-of-line: everything granted
+                        # AFTER it goes back too, or a sustained stream
+                        # of newer short requests would consume each
+                        # tick's freed blocks and starve it forever
+                        idx = granted.index(task)
+                        for later in granted[idx:]:
+                            self.scheduler.resubmit(later)
+                        break
                     else:
-                        emitted += self._admit(task.request)
+                        req._hold_blocks = 0
+                        admitted += 1
+                        emitted += self._admit(req)
             # 3. one decode pass over the pool (PREFILLING slots are
             # assigned but not yet decodable — their first token comes
             # from their final prefill chunk)
@@ -634,6 +850,10 @@ class ServingEngine:
             for task in granted:
                 req = task.request
                 if req.state is RequestState.QUEUED:
+                    # a preempt-requeued request's task is back in the
+                    # queue — pull the corpse so _fail_all's drain (or
+                    # a later tick) cannot retire it a second time
+                    self.scheduler.remove(task)
                     req.error = e
                     self._finish(req, RequestState.FAILED)
             raise
@@ -659,46 +879,72 @@ class ServingEngine:
             # live credit level (post-return = the budget the next
             # tick's admission scan starts from)
             self.metrics.gauge(sm.PREFILL_CREDITS, self.scheduler.credits)
-        return {"admitted": len(granted), "emitted": emitted,
+            if self.paged:
+                bs = self.pool.block_stats()
+                self.metrics.gauge(sm.KV_BLOCKS_FREE, bs["free"])
+                self.metrics.gauge(sm.KV_BLOCKS_USED, bs["used"])
+                self.metrics.gauge(sm.KV_BLOCKS_SHARED, bs["shared"])
+        # "admitted" counts requests actually assigned a slot this tick
+        # — NOT cancelled grants or held (resubmitted) tasks
+        return {"admitted": admitted, "emitted": emitted,
                 "active": self.pool.active_count,
                 "queued": self.scheduler.depth,
                 "prefill_tokens": self._tick_prefill}
 
     def _admit(self, req: Request) -> int:
-        T = int(req.prompt.shape[0])
+        # the sequence this admission must prefill: the prompt, or —
+        # when resuming a preempted request — prompt + emitted tokens
+        # minus the last (its K/V is unwritten; it is the next decode
+        # input, parked in _resume_tok)
+        k = len(req.tokens)
+        seq = (req.prompt if k == 0 else
+               np.concatenate([req.prompt,
+                               np.asarray(req.tokens[:-1], np.int32)]))
+        req._seq = seq
+        T = int(seq.shape[0])
         slot = self.pool.assign(req.id, T)
         assert slot is not None, "admit() granted beyond free slots"
         req.slot = slot
-        req.t_admit = time.monotonic()
+        if not req.t_admit:  # keep the first admission's queue-wait
+            req.t_admit = time.monotonic()
+            self.metrics.bump(sm.ADMITTED)
         self._slot_req[slot] = req
-        self.metrics.bump(sm.ADMITTED)
         p0 = 0
         if self.prefix is not None:
             req._prefix_digs = self.prefix.digests_for(
-                req.prompt, salt=self._prefix_salt)
-            m = self.prefix.match(req.prompt, salt=self._prefix_salt,
+                seq, salt=self._prefix_salt)
+            m = self.prefix.match(seq, salt=self._prefix_salt,
                                   digests=req._prefix_digs)
             if m is not None:
                 entry, p0 = m
-                # pin across the device copy, then resume prefill at
-                # the boundary — the copied bytes ARE the K/V whole
-                # prefill would recompute, so parity is by construction
+                # pin across the attach/copy, then resume prefill at
+                # the boundary — the shared (or copied) bytes ARE the
+                # K/V whole prefill would recompute, so parity is by
+                # construction
                 self.prefix.acquire(entry)
                 try:
-                    self.pool.caches = self._prefix_copy_fn()(
-                        self.pool.caches, entry.buffer, slot)
+                    if self.paged:
+                        # zero-copy prefix hit: the slot's table adopts
+                        # the entry's blocks (refcount bumps, no device
+                        # work — the acceptance criterion the compile
+                        # counters pin)
+                        self.pool.share_prefix(
+                            slot, entry.buffer[:p0 // self.pool.block])
+                    else:
+                        self.pool.caches = self._prefix_copy_fn()(
+                            self.pool.caches, entry.buffer, slot)
                 finally:
                     self.prefix.release(entry)
                 self.metrics.bump(sm.PREFIX_HITS)
                 self.metrics.bump(sm.PREFIX_HIT_TOKENS, p0)
             else:
                 self.metrics.bump(sm.PREFIX_MISSES)
-        if p0 == 0 and not self.chunk:
+        if p0 == 0 and not self.chunk and not self.paged:
             # whole-prompt prefill (the pre-chunking path, bit-identical)
             req.state = RequestState.ACTIVE
             bucket = _next_bucket(T, self.min_prefill_bucket, self.max_seq)
             padded = np.full((1, bucket), self.pad_id, np.int32)
-            padded[0, :T] = req.prompt
+            padded[0, :T] = seq
             key = (jnp.zeros((2,), jnp.uint32) if self.greedy
                    else jax.random.PRNGKey(req.seed))
             fn = self._prefill_fn(bucket)
@@ -713,9 +959,10 @@ class ServingEngine:
             self._maybe_insert_prefix(req)
             self._emit(req, int(tok0))
             return 1
-        # chunked (or prefix-resumed) prefill: the request parks in
-        # PREFILLING with the slot held; the admission grant pre-paid
-        # its first chunk, later chunks debit the shared credit pool
+        # chunked (or prefix-resumed, or paged) prefill: the request
+        # parks in PREFILLING with the slot held; the admission grant
+        # pre-paid its first chunk, later chunks debit the shared
+        # credit pool
         req.state = RequestState.PREFILLING
         req.prefill_pos = p0
         req._pf_paid = True
@@ -726,8 +973,11 @@ class ServingEngine:
         """Run as many prefill chunks for ``req`` as the tick's credits
         allow.  Returns 1 when the final chunk completed (first token
         emitted), else 0 — the request stays PREFILLING and resumes on
-        the next tick's continuation pass with a fresh budget."""
-        T = int(req.prompt.shape[0])
+        the next tick's continuation pass with a fresh budget (0 is
+        also the answer when block pressure preempted or failed the
+        request mid-pass; the slot is gone then)."""
+        seq = req._seq if req._seq is not None else req.prompt
+        T = int(seq.shape[0])
         slot = req.slot
         S = self.max_seq
         while True:
@@ -765,17 +1015,44 @@ class ServingEngine:
             # already in the row rewrites identical bytes (position-wise
             # determinism, docs/serving.md), so the overlap is bit-exact
             start = min(p0, S - bucket)
+            if self.paged:
+                # lazy block grant for the chunk's REAL tokens only
+                # (min(..., T)): the padded bucket tail's writes route
+                # to the null block through the table's null-filled
+                # entries, so granting blocks for pure padding would
+                # hold ghost memory for the slot's whole lifetime.
+                # Then copy-on-write forks for any shared block the
+                # span touches (only the shift-left re-feed can reach
+                # one; the fork copy makes the identical-bytes rewrite
+                # land in a private clone, keeping shared blocks
+                # immutable)
+                if not self._with_block_pressure(
+                        req, lambda: self.pool.ensure_blocks(
+                            slot, min(start + bucket, T))):
+                    return 0
+                if not self._with_block_pressure(
+                        req, lambda: self.pool.make_writable(
+                            slot, start, start + bucket,
+                            self._cow_copy)):
+                    return 0
             toks = np.full((1, bucket), self.pad_id, np.int32)
             end = min(start + bucket, T)
-            toks[0, :end - start] = req.prompt[start:end]
+            toks[0, :end - start] = seq[start:end]
             final = p0 + csize >= T
             last_idx = (T - 1 - start) if final else (bucket - 1)
             key = (jnp.zeros((2,), jnp.uint32) if self.greedy
                    else jax.random.PRNGKey(req.seed))
-            fn = self._chunk_fn(bucket)
-            caches, tok0, nk = fn(self.variables, self.pool.caches,
-                                  jnp.asarray(toks), slot, start,
-                                  last_idx, key)
+            if self.paged:
+                fn = self._paged_chunk_fn(bucket)
+                caches, tok0, nk = fn(self.variables, self.pool.caches,
+                                      jnp.asarray(toks),
+                                      self.pool.table_row(slot), start,
+                                      last_idx, key)
+            else:
+                fn = self._chunk_fn(bucket)
+                caches, tok0, nk = fn(self.variables, self.pool.caches,
+                                      jnp.asarray(toks), slot, start,
+                                      last_idx, key)
             self.pool.caches = caches
             req.prefill_pos = p0 + csize
             self.metrics.bump(sm.PREFILL_TOKENS, bucket)
@@ -784,6 +1061,22 @@ class ServingEngine:
             if final:
                 del self._prefilling[slot]
                 req.state = RequestState.ACTIVE
+                if req._resume_tok is not None:
+                    # resuming a preempted request: the K/V for every
+                    # already-emitted token is rebuilt; the final
+                    # chunk's sampled token AND its key split are
+                    # discarded, and the parked next-input token plus
+                    # the carried key are restored — the per-request
+                    # key chain continues exactly once-per-step, so
+                    # seeded streams stay bit-exact across preemption
+                    self._tok = self._tok.at[slot].set(req._resume_tok)
+                    if not self.greedy and req._resume_key is not None:
+                        self._keys = self._keys.at[slot].set(
+                            jnp.asarray(req._resume_key))
+                    req._resume_tok = None
+                    req._resume_key = None
+                    self._maybe_insert_prefix(req)
+                    return 0  # nothing emitted; decode resumes next
                 self._tok = self._tok.at[slot].set(tok0)
                 if not self.greedy:
                     self._keys = self._keys.at[slot].set(nk)
@@ -791,43 +1084,166 @@ class ServingEngine:
                 self._emit(req, int(tok0))
                 return 1
 
+    def _with_block_pressure(self, req: Request, fn) -> bool:
+        """Run ``fn()`` (a block allocation on behalf of ``req``); on
+        :class:`BlocksExhaustedError`, reclaim memory and retry:
+
+          1. evict unpinned prefix-cache entries (cheapest — cached
+             prefixes can always be recomputed);
+          2. preempt the NEWEST other in-flight request back to QUEUED
+             (vLLM's recompute preemption: oldest work finishes first,
+             so the system always makes forward progress);
+          3. if ``req`` is itself the newest, it yields — preempted
+             back to QUEUED to resume when older requests finish;
+          4. a request that cannot fit the pool even alone fails
+             loudly with the typed error attached.
+
+        True = ``fn`` succeeded.  False = ``req`` lost its slot
+        (preempted or failed); the caller abandons it this tick."""
+        while True:
+            try:
+                fn()
+                return True
+            except BlocksExhaustedError as e:
+                if self.prefix is not None and self.prefix.evict_for(
+                        max(1, e.needed - e.free)):
+                    continue
+                others = [self._slot_req[s]
+                          for s in self.pool.active_slots()
+                          if self._slot_req[s] is not None
+                          and self._slot_req[s] is not req]
+                newer = [r for r in others if r.id > req.id]
+                if newer:
+                    self._preempt(max(newer, key=lambda r: r.id))
+                    continue
+                if others:
+                    # req is the newest holder: it yields rather than
+                    # deadlocking requests admitted before it
+                    self._preempt(req)
+                    return False
+                # alone and still short: the pool can never fit this
+                # request — fail it with the typed error
+                req.error = e
+                self._finish(req, RequestState.FAILED)
+                return False
+
+    def _preempt(self, victim: Request) -> None:
+        """Preempt an in-flight request back to QUEUED (paged engine,
+        KV block pressure): its slot and non-shared blocks return to
+        the pool NOW; on re-admission it re-prefills prompt + emitted
+        tokens (position-wise determinism makes the rebuilt K/V
+        bit-identical to what incremental decode wrote) and resumes
+        decoding from its parked next-input token and sampling key.
+        Already-streamed tokens are kept — consumers see a stall, never
+        a replay.  Re-queued via the ORIGINAL scheduler task, so it
+        re-enters ahead of later submissions."""
+        slot = victim.slot
+        if victim.state is RequestState.ACTIVE and victim.tokens:
+            victim._resume_tok = int(np.asarray(self._tok[slot]))
+            if not self.greedy:
+                victim._resume_key = np.asarray(self._keys[slot])
+        # a PREFILLING victim keeps whatever resume state it carries: a
+        # request preempted a SECOND time mid-resume still owes exactly
+        # the parked token and key it owed before — clobbering them
+        # would re-emit the parked token as a fresh "first" token
+        self._prefilling.pop(slot, None)
+        self._slot_req[slot] = None
+        self.pool.free(slot)  # releases the table's block references
+        victim.slot = None
+        victim.prefill_pos = 0
+        victim._pf_paid = False
+        victim._seq = None
+        # re-admission watermark: worst-case blocks to complete (prefix
+        # sharing can only shrink the real need, so this is safe-side)
+        victim._hold_blocks = -(-(int(victim.prompt.shape[0])
+                                  + victim.max_new_tokens)
+                                // self.pool.block)
+        victim.state = RequestState.QUEUED
+        self.scheduler.resubmit(victim._task)
+        self.metrics.bump(sm.PREEMPTIONS)
+
     def _maybe_insert_prefix(self, req: Request) -> None:
-        """After a completed prefill, capture the prompt's block-aligned
-        prefix K/V into the store (skipped when already indexed)."""
+        """After a completed prefill, capture the sequence's block-
+        aligned prefix K/V into the store (skipped when already
+        indexed).  Paged engines register the slot's own blocks —
+        refcount bumps, zero device-side copies; dense engines pay the
+        jitted zero-masked row extract."""
         if self.prefix is None:
             return
-        if (self.prefix.max_bytes
-                and self._prefix_row_bytes > self.prefix.max_bytes):
-            return
-        ins = self.prefix.insertable_len(req.prompt,
+        seq = req._seq if req._seq is not None else req.prompt
+        ins = self.prefix.insertable_len(seq,
                                          salt=self._prefix_salt,
                                          digests=req._prefix_digs)
         if ins <= 0:
             return
+        if self.paged:
+            ids = self.pool.tables[req.slot].blocks[
+                :ins // self.pool.block]
+            if (len(ids) == ins // self.pool.block
+                    and self.prefix.insert_blocks(
+                        seq[:ins], ids, salt=self._prefix_salt,
+                        digests=req._prefix_digs)):
+                self.metrics.bump(sm.PREFIX_INSERTIONS)
+            return
+        if (self.prefix.max_bytes
+                and self._prefix_row_bytes > self.prefix.max_bytes):
+            return
         buf = self._prefix_extract_fn()(self.pool.caches, req.slot, ins)
-        if self.prefix.insert(req.prompt[:ins], buf,
+        if self.prefix.insert(seq[:ins], buf,
                               salt=self._prefix_salt,
                               digests=req._prefix_digs):
             self.metrics.bump(sm.PREFIX_INSERTIONS)
 
     def _decode_tick(self, active: List[int]) -> int:
         n = self.pool.n_slots
+        if self.paged:
+            # lazy block grant at the boundary crossing: a slot whose
+            # cursor enters an uncovered block gets one here — under
+            # pressure this is where prefix eviction / preemption fires
+            for slot in list(active):
+                req = self._slot_req[slot]
+                if req is None:
+                    continue  # a victim of an earlier preemption
+                if not self._with_block_pressure(
+                        req, lambda s=slot: self.pool.ensure_blocks(
+                            s, self.pool.pos[s] + 1)):
+                    continue
+            active = [s for s in active
+                      if self._slot_req[s] is not None
+                      and s not in self._prefilling]
+            if not active:
+                return 0
         pos = np.zeros((n,), np.int32)
         mask = np.zeros((n,), bool)
         for slot in active:
             pos[slot] = self.pool.pos[slot]
             mask[slot] = True
-        # PREFILLING slots ride the decode step masked-off like freed
-        # slots do, but their garbage K/V write must NOT land at pos 0
-        # (it would corrupt the copied prefix / already-written chunks):
-        # aim it at the slot's post-prefill cursor, which the request's
-        # own first real decode overwrites before the causal mask can
-        # ever admit it
-        for slot in self._prefilling:
-            pos[slot] = self.pool.pos[slot]
-        caches, nxt, keys = self._decode_step(
-            self.variables, self.pool.caches, self._tok,
-            jnp.asarray(pos), jnp.asarray(mask), self._keys)
+        if self.paged:
+            # scatter targets: each active slot writes its cursor's
+            # (block, offset); masked slots (free or PREFILLING) write
+            # the null block, so their garbage can never land in a
+            # shared prefix block or a mid-prefill row
+            wblk = np.full((n,), self.pool.null_block, np.int32)
+            woff = np.zeros((n,), np.int32)
+            for slot in active:
+                wblk[slot], woff[slot] = self.pool.write_target(slot)
+            caches, nxt, keys = self._decode_step(
+                self.variables, self.pool.caches, self._tok,
+                jnp.asarray(pos), jnp.asarray(mask), self._keys,
+                self.pool.tables_device(), jnp.asarray(wblk),
+                jnp.asarray(woff))
+        else:
+            # PREFILLING slots ride the decode step masked-off like
+            # freed slots do, but their garbage K/V write must NOT land
+            # at pos 0 (it would corrupt the copied prefix / already-
+            # written chunks): aim it at the slot's post-prefill
+            # cursor, which the request's own first real decode
+            # overwrites before the causal mask can ever admit it
+            for slot in self._prefilling:
+                pos[slot] = self.pool.pos[slot]
+            caches, nxt, keys = self._decode_step(
+                self.variables, self.pool.caches, self._tok,
+                jnp.asarray(pos), jnp.asarray(mask), self._keys)
         self.pool.caches = caches
         self._tok = nxt
         self._keys = keys
@@ -990,4 +1406,5 @@ class ServingEngine:
                 "chunk": self.chunk_traces,
                 "chunk_buckets": len(self._chunk_fns),
                 "prefix_copy": self.prefix_copy_traces,
-                "prefix_extract": self.prefix_extract_traces}
+                "prefix_extract": self.prefix_extract_traces,
+                "block_cow": self.block_cow_traces}

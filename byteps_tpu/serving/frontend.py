@@ -117,6 +117,12 @@ class _ServeHandler(socketserver.BaseRequestHandler):
                              "prefix_cache": (engine.prefix.stats()
                                               if engine.prefix is not None
                                               else None),
+                             # paged KV pool accounting (None on dense
+                             # engines) — free/used/shared block counts
+                             # next to the prefix stats they interact
+                             # with (docs/serving.md "Paged KV cache")
+                             "kv_blocks": (engine.pool.block_stats()
+                                           if engine.paged else None),
                              # the same registry snapshot /metrics.json
                              # serves — one stats surface, two transports
                              # (docs/observability.md)
@@ -317,6 +323,9 @@ def serve_from_env(env=None) -> int:
         chunk=cfg.serve_chunk,
         prefix_cache=cfg.serve_prefix_cache,
         prefix_block=cfg.serve_prefix_block,
-        prefix_bytes=cfg.serve_prefix_mb << 20)
+        prefix_bytes=cfg.serve_prefix_mb << 20,
+        paged=cfg.serve_paged,
+        block=cfg.serve_block,
+        kv_mb=cfg.serve_kv_mb)
     serve(engine, cfg.serve_port)
     return 0

@@ -41,6 +41,15 @@ PREFIX_HITS = "serve.prefix_hits"
 PREFIX_MISSES = "serve.prefix_misses"
 PREFIX_HIT_TOKENS = "serve.prefix_hit_tokens"
 PREFIX_INSERTIONS = "serve.prefix_insertions"
+# paged KV cache (serving/blocks.py): live block-pool accounting
+# (gauges, per tick) plus the pressure-path counters — prefix-entry
+# evictions that released blocks, and requests preempted back to
+# QUEUED when the pool ran dry mid-flight
+KV_BLOCKS_FREE = "serve.kv_blocks_free"
+KV_BLOCKS_USED = "serve.kv_blocks_used"
+KV_BLOCKS_SHARED = "serve.kv_blocks_shared"
+BLOCK_EVICTIONS = "serve.block_evictions"
+PREEMPTIONS = "serve.preemptions"
 # per-tick value tracks (gauges, not monotonic)
 OCCUPANCY = "serve.batch_occupancy"
 QUEUE_DEPTH = "serve.queue_depth"

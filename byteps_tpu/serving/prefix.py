@@ -49,7 +49,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["PrefixCache", "PrefixEntry", "weights_fingerprint"]
+__all__ = ["PrefixCache", "PagedPrefixCache", "PrefixEntry",
+           "weights_fingerprint"]
 
 
 def _tree_bytes(tree) -> int:
@@ -261,6 +262,30 @@ class PrefixCache:
                 raise ValueError("release() without matching acquire()")
             entry.refs -= 1
 
+    def _evict_entry_locked(self, victim: PrefixEntry) -> None:
+        self._entries.remove(victim)
+        for digest, blen in victim.keys:
+            self._index.pop(digest, None)
+            # a boundary first registered by the victim may be
+            # covered by a LATER entry that shares its blocks (insert
+            # only registers boundaries it does not already find):
+            # re-point the key at a surviving cover, or shared-prefix
+            # lookups would miss K/V the store still holds
+            for heir in self._entries:
+                if (heir.salt == victim.salt
+                        and heir.length >= blen and np.array_equal(
+                            heir.tokens[:blen], victim.tokens[:blen])):
+                    self._index[digest] = (heir, blen)
+                    heir.keys.append((digest, blen))
+                    break
+        self.evictions += 1
+        self._release_entry(victim)
+
+    def _release_entry(self, victim: PrefixEntry) -> None:
+        """Storage-release hook: the base store owns plain device
+        buffers (GC'd with the entry); the paged subclass drops block
+        references here."""
+
     def _evict_to_budget_locked(self) -> None:
         if not self.max_bytes:
             return
@@ -268,23 +293,7 @@ class PrefixCache:
             victims = [e for e in self._entries if e.refs == 0]
             if not victims:
                 return  # everything pinned; retry on the next insert
-            victim = min(victims, key=lambda e: e.stamp)
-            self._entries.remove(victim)
-            for digest, blen in victim.keys:
-                self._index.pop(digest, None)
-                # a boundary first registered by the victim may be
-                # covered by a LATER entry that shares its blocks (insert
-                # only registers boundaries it does not already find):
-                # re-point the key at a surviving cover, or shared-prefix
-                # lookups would miss K/V the store still holds
-                for heir in self._entries:
-                    if (heir.salt == victim.salt
-                            and heir.length >= blen and np.array_equal(
-                                heir.tokens[:blen], victim.tokens[:blen])):
-                        self._index[digest] = (heir, blen)
-                        heir.keys.append((digest, blen))
-                        break
-            self.evictions += 1
+            self._evict_entry_locked(min(victims, key=lambda e: e.stamp))
 
     # ---------------------------------------------------------- inspection
 
@@ -305,3 +314,122 @@ class PrefixCache:
                     "insertions": self.insertions,
                     "evictions": self.evictions,
                     "entries": len(self._entries), "bytes": total}
+
+
+class PagedPrefixCache(PrefixCache):
+    """Prefix store over a **paged** KV pool: entries hold physical
+    block ids instead of copied row buffers (serving/blocks.py).
+
+    This is the unification the paged refactor buys (SGLang's
+    RadixAttention observation): the prefix store was already
+    block-aligned, so once the cache itself is block-granular a prefix
+    *is* a list of blocks —
+
+      * **insert is refcount bumps**: the request's own prefix blocks
+        gain a store reference (``entry.buffer`` = block id tuple); no
+        device-side extract, no duplicate bytes;
+      * **a hit is sharing**: the admitted slot's table adopts the
+        entry's blocks (another refcount bump) — zero device-side K/V
+        copies for whole shared blocks, enforced by the engine's
+        compile counters (``prefix_copy``/``prefix_extract`` stay 0);
+      * **eviction respects live refs**: dropping an entry decrefs its
+        blocks, and the allocator frees a block only when no slot maps
+        it — an LRU eviction can never yank K/V out from under a
+        decoding request.
+
+    Matching, hashing, verification, LRU, and the heir-repointing
+    eviction rule are inherited unchanged.  A store is bound to ONE
+    allocator (block ids are meaningless across pools), so paged
+    engines cannot share a store unless they share a pool —
+    ``ServingEngine`` refuses the cross-engine case loudly.
+
+    Budget accounting is **per reference, not per physical block**:
+    two entries whose block lists overlap each charge their full
+    length against ``max_bytes``, so the reported total can exceed the
+    physically pinned bytes and eviction errs toward keeping the store
+    *smaller* than the budget — conservative by construction, never
+    an overrun.  (Deduplicating the charge would require eviction to
+    know which surviving entries still cover each block; the simple
+    rule keeps release unconditional: every entry decrefs exactly the
+    ids it increfed.)
+
+    Under block pressure the engine calls :meth:`evict_for` *before*
+    preempting live requests: cached-but-unreferenced prefixes are the
+    cheapest memory to reclaim (they can always be recomputed).
+    """
+
+    def __init__(self, allocator, block: int, block_bytes: int,
+                 max_bytes: int = 0, on_evict=None):
+        super().__init__(block=block, max_bytes=max_bytes)
+        self.allocator = allocator
+        self.block_bytes = block_bytes
+        self._on_evict = on_evict
+        self.blocks_released = 0
+
+    def insert(self, tokens, buffer, salt: bytes = b"",
+               digests: Optional[List[bytes]] = None) -> bool:
+        raise TypeError(
+            "PagedPrefixCache stores block references, not row buffers;"
+            " use insert_blocks()")
+
+    def insert_blocks(self, tokens, block_ids, salt: bytes = b"",
+                      digests: Optional[List[bytes]] = None) -> bool:
+        """Register ``tokens``' block-aligned prefix as shared blocks:
+        every id in ``block_ids`` gains a store reference.  Returns
+        False when nothing was stored (already indexed, or over the
+        whole byte budget); on False no references were taken."""
+        toks = np.asarray(tokens, np.int32).reshape(-1).copy()
+        length = int(toks.shape[0])
+        nblocks = len(block_ids)
+        if length != nblocks * self.block or nblocks < 1:
+            raise ValueError(
+                f"insert length {length} does not cover {nblocks} "
+                f"block(s) of {self.block} tokens")
+        if digests is not None and len(digests) >= nblocks:
+            digs = digests[:nblocks]
+        else:
+            digs = self._digests(toks, nblocks, salt)
+        nbytes = nblocks * self.block_bytes
+        with self._lock:
+            if digs[-1] in self._index:
+                return False  # already indexed
+            if self.max_bytes and nbytes > self.max_bytes:
+                return False  # a single entry cannot fit the budget
+            for bid in block_ids:
+                self.allocator.incref(bid)
+            entry = PrefixEntry(tuple(block_ids), toks, length, nbytes,
+                                next(self._clock), salt)
+            for j in range(1, nblocks + 1):
+                if digs[j - 1] not in self._index:
+                    self._index[digs[j - 1]] = (entry, j * self.block)
+                    entry.keys.append((digs[j - 1], j * self.block))
+            self._entries.append(entry)
+            self.insertions += 1
+            self._evict_to_budget_locked()
+            return True
+
+    def _release_entry(self, victim: PrefixEntry) -> None:
+        for bid in victim.buffer:
+            self.allocator.decref(bid)
+        self.blocks_released += len(victim.buffer)
+        if self._on_evict is not None:
+            self._on_evict(len(victim.buffer))
+
+    def evict_for(self, n_blocks: int) -> bool:
+        """Block-pressure eviction: drop LRU unpinned entries until the
+        allocator has gained ``n_blocks`` free blocks or nothing
+        evictable remains.  Returns True when at least one entry was
+        dropped (the caller retries its allocation).  Note an evicted
+        entry frees only blocks no live slot shares — reclaiming less
+        than ``len(entry.buffer)`` is normal, not a bug."""
+        with self._lock:
+            before = self.allocator.free_count
+            progressed = False
+            while self.allocator.free_count - before < n_blocks:
+                victims = [e for e in self._entries if e.refs == 0]
+                if not victims:
+                    break
+                self._evict_entry_locked(min(victims,
+                                             key=lambda e: e.stamp))
+                progressed = True
+            return progressed

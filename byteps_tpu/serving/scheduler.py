@@ -101,6 +101,19 @@ class ServeScheduler:
 
     # -------------------------------------------------------------- grant
 
+    def resubmit(self, task: PrefillTask) -> None:
+        """Re-queue a **preempted** request's original task (paged
+        engine, KV block pressure — serving/engine.py ``_preempt``).
+        The task keeps its original monotonic key, so within its
+        priority it re-enters AHEAD of everything submitted after it —
+        a preempted request resumes in its original admission order
+        instead of going to the back.  Deliberately bypasses the
+        ``max_queue`` bound: preemption must never be lossy, and the
+        request was already accounted for when first submitted."""
+        with self._lock:
+            self._depth += 1
+        self._q.add_task(task)
+
     def admit(self, max_grants: int) -> List[PrefillTask]:
         """Grant up to ``max_grants`` prefills within the credit budget
         (one engine tick's admissions).  Cancelled requests are granted

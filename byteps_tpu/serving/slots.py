@@ -56,9 +56,11 @@ class SlotPool:
         # defaults to the grouped layout: the decode step vmaps the
         # model's per-row decode over slots, and the grouped dense path
         # batches cleanly under vmap on every backend (the flat Pallas
-        # kernel is a TPU-only single-program fast path).
-        self.caches = init_cache(cfg, n_slots, max_seq,
-                                 quantized=kv_quant, layout=layout)
+        # kernel is a TPU-only single-program fast path).  Subclasses
+        # override _init_caches to swap the storage layout (the paged
+        # block pool, serving/blocks.py) while inheriting the slot
+        # bookkeeping unchanged.
+        self.caches = self._init_caches()
         self._lock = threading.Lock()
         self._free: List[int] = list(range(n_slots))
         heapq.heapify(self._free)
@@ -66,6 +68,10 @@ class SlotPool:
         # (== number of real tokens the slot's row currently holds)
         self.pos: List[int] = [0] * n_slots
         self.request_ids: List[Optional[int]] = [None] * n_slots
+
+    def _init_caches(self):
+        return init_cache(self.cfg, self.n_slots, self.max_seq,
+                          quantized=self.kv_quant, layout=self.layout)
 
     # ------------------------------------------------------------ lifecycle
 

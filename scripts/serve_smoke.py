@@ -18,9 +18,16 @@ sequential ``generate()`` baselines and to a cache-off engine run of
 the same jobs — prefix reuse copies K/V bytes instead of recomputing
 them, so parity is exact, not approximate.
 
+``--paged`` reruns either workload on the paged KV engine over a
+deliberately tight block pool, so randomized arrivals exercise lazy
+block grants, zero-copy prefix sharing, prefix-store pressure
+eviction, and preempt/resume — every path must stay token-identical
+to the same sequential baselines (docs/serving.md "Paged KV cache").
+
 Usage:
     python scripts/serve_smoke.py [--requests 12] [--seed 0]
     python scripts/serve_smoke.py --prefix-share
+    python scripts/serve_smoke.py --paged [--prefix-share]
 
 Wired into CI as a ``slow``-marked pytest (tests/test_serve_smoke.py)
 so tier-1 stays fast.
@@ -42,7 +49,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 def run(requests: int = 12, seed: int = 0, n_slots: int = 4,
         temperature: float = 0.0, verbose: bool = True,
-        prefix_share: bool = False) -> dict:
+        prefix_share: bool = False, paged: bool = False) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -88,6 +95,14 @@ def run(requests: int = 12, seed: int = 0, n_slots: int = 4,
         baselines.append(np.asarray(out["tokens"])[0])
 
     engine_kw = dict(sample_kw)
+    if paged:
+        # paged KV cache under a DELIBERATELY tight block pool (the
+        # floor is max_blocks + 1 = 13 at max_seq 96 / block 8; 16
+        # leaves real pressure at 4 slots x up to 5 blocks each), so
+        # randomized threaded arrivals exercise lazy grants, prefix
+        # eviction, AND preempt/resume — all of which must preserve
+        # bit-exact parity per request
+        engine_kw.update(paged=True, block=8, kv_blocks=16)
     off_out = None
     if prefix_share:
         engine_kw.update(chunk=8, prefix_cache=True, prefix_block=8)
@@ -169,8 +184,11 @@ def run(requests: int = 12, seed: int = 0, n_slots: int = 4,
              "prefill_buckets": counts["prefill_buckets"],
              "chunk_buckets": counts["chunk_buckets"],
              "prefix_copy_traces": counts["prefix_copy"],
+             "prefix_extract_traces": counts["prefix_extract"],
              "temperature": temperature,
              **engine.metrics.snapshot()}
+    if paged:
+        stats["block_stats"] = engine.pool.block_stats()
     if verbose:
         print(stats)
     return stats
@@ -184,15 +202,25 @@ def main(argv=None) -> int:
     ap.add_argument("--prefix-share", action="store_true",
                     help="shared-prefix workload with chunked prefill "
                          "+ prefix cache, parity vs a cache-off run")
+    ap.add_argument("--paged", action="store_true",
+                    help="paged KV cache on a deliberately tight block "
+                         "pool: lazy grants, zero-copy prefix shares, "
+                         "and preempt/resume under threaded arrivals "
+                         "must all keep bit-exact parity")
     args = ap.parse_args(argv)
     ok = True
     for temp in (0.0, 0.8):
         stats = run(requests=args.requests, seed=args.seed,
                     n_slots=args.slots, temperature=temp,
-                    prefix_share=args.prefix_share)
+                    prefix_share=args.prefix_share, paged=args.paged)
         ok = ok and stats["mismatches"] == 0 and stats["decode_traces"] == 1
         if args.prefix_share:
             ok = ok and stats.get("serve.prefix_hits", 0) > 0
+        if args.paged:
+            # zero-copy contract: no prefix copy/extract program may
+            # even exist on a paged engine
+            ok = (ok and stats["prefix_copy_traces"] == 0
+                  and stats["prefix_extract_traces"] == 0)
     print("serve_smoke:", "OK" if ok else "FAILED")
     return 0 if ok else 1
 

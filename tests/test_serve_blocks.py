@@ -1,0 +1,197 @@
+"""Block allocator invariants (serving/blocks.py) — pure host-side
+Python, no jitted programs, no compile cost: alloc/free/refcount
+discipline, copy-on-write forks of partially shared tables, typed
+exhaustion, and the eviction-respects-live-refs contract of the paged
+prefix store (entries drop their references; a block a live table
+still maps is never freed)."""
+
+import numpy as np
+import pytest
+
+from byteps_tpu.serving import (
+    BlockAllocator,
+    BlocksExhaustedError,
+    BlockTable,
+    PagedPrefixCache,
+)
+from byteps_tpu.serving.scheduler import AdmissionError
+
+
+# --------------------------------------------------------------- allocator
+
+
+def test_alloc_lowest_first_and_refcounts():
+    a = BlockAllocator(6, block=8)
+    assert a.alloc(2) == [0, 1]  # deterministic lowest-free-id
+    assert a.alloc(1) == [2]
+    assert (a.free_count, a.used_count) == (3, 3)
+    assert a.refs(0) == 1
+    assert a.incref(0) == 2
+    assert a.shared_count() == 1
+    assert a.decref(0) == 1  # still held
+    assert a.refs(0) == 1 and a.free_count == 3
+    assert a.decref(0) == 0  # freed
+    assert a.free_count == 4
+    # a freed block is reused first (lowest id)
+    assert a.alloc(1) == [0]
+
+
+def test_alloc_exhaustion_is_typed_and_atomic():
+    a = BlockAllocator(3, block=4)
+    a.alloc(2)
+    with pytest.raises(BlocksExhaustedError) as ei:
+        a.alloc(2)  # only 1 free
+    assert ei.value.needed == 2 and ei.value.free == 1
+    # typed backpressure: same family the frontend surfaces as status=1
+    assert isinstance(ei.value, AdmissionError)
+    # atomic: the one free block was NOT consumed by the failed call
+    assert a.free_count == 1
+    assert a.alloc(1) == [2]
+
+
+def test_refcount_misuse_raises():
+    a = BlockAllocator(2, block=4)
+    with pytest.raises(ValueError):
+        a.incref(0)  # free block
+    with pytest.raises(ValueError):
+        a.decref(1)  # free block
+    bid = a.alloc(1)[0]
+    a.decref(bid)
+    with pytest.raises(ValueError):
+        a.decref(bid)  # double free
+
+
+# ------------------------------------------------------------- block table
+
+
+def test_table_ensure_grows_lazily_and_atomically():
+    a = BlockAllocator(4, block=8)
+    t = BlockTable(max_blocks=4)
+    assert t.ensure(a, 2) == [0, 1]
+    assert t.ensure(a, 2) == []  # already covered
+    assert t.ensure(a, 3) == [2]
+    with pytest.raises(BlocksExhaustedError):
+        BlockTable(max_blocks=8).ensure(a, 2)  # only 1 free
+    assert a.free_count == 1  # atomic: nothing leaked
+    with pytest.raises(ValueError):
+        t.ensure(a, 5)  # beyond max_blocks
+    t.release(a)
+    assert a.free_count == 4 and len(t) == 0
+
+
+def test_table_share_and_cow_fork_of_partially_shared_table():
+    a = BlockAllocator(8, block=8)
+    owner = BlockTable(max_blocks=4)
+    owner.ensure(a, 3)                  # blocks [0, 1, 2]
+    # a second table shares the first two blocks (a prefix hit)
+    borrower = BlockTable(max_blocks=4)
+    borrower.share(a, owner.blocks[:2])
+    assert borrower.blocks == [0, 1]
+    assert a.refs(0) == 2 and a.refs(1) == 2 and a.refs(2) == 1
+    assert a.shared_count() == 2
+    # COW: forking a shared entry allocates a private clone and drops
+    # the shared ref; the owner's mapping is untouched
+    pair = borrower.cow(a, 1)
+    assert pair == (1, 3)               # old id 1 -> fresh id 3
+    assert borrower.blocks == [0, 3]
+    assert a.refs(1) == 1 and a.refs(3) == 1
+    # already-private entries are not forked
+    assert borrower.cow(a, 1) is None
+    # share() refuses a non-empty table (prefixes attach at admission)
+    with pytest.raises(ValueError):
+        borrower.share(a, [2])
+    # releasing the borrower frees only its private/exclusive refs
+    borrower.release(a)
+    assert a.refs(0) == 1               # owner still maps block 0
+    assert a.refs(3) == 0               # the clone is gone
+    owner.release(a)
+    assert a.used_count == 0
+
+
+def test_cow_exhaustion_leaves_table_unchanged():
+    a = BlockAllocator(2, block=8)
+    owner = BlockTable(max_blocks=2)
+    owner.ensure(a, 2)
+    sharer = BlockTable(max_blocks=2)
+    sharer.share(a, owner.blocks[:1])
+    with pytest.raises(BlocksExhaustedError):
+        sharer.cow(a, 0)  # no free block for the clone
+    assert sharer.blocks == [0] and a.refs(0) == 2
+
+
+# ----------------------------------------------------- paged prefix store
+
+
+def _toks(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, 50, size=(n,)).astype(np.int32)
+
+
+def test_paged_prefix_insert_is_refcount_bumps_and_hit_shares():
+    a = BlockAllocator(8, block=4)
+    store = PagedPrefixCache(a, block=4, block_bytes=100, max_bytes=0)
+    ids = a.alloc(2)  # a slot's own prefix blocks
+    toks = _toks(8, seed=1)
+    assert store.insert_blocks(toks, ids)
+    assert a.refs(ids[0]) == 2 and a.refs(ids[1]) == 2
+    # duplicate insert takes no additional references
+    assert not store.insert_blocks(toks, ids)
+    assert a.refs(ids[0]) == 2
+    # a longer prompt sharing the prefix matches at the boundary
+    m = store.match(np.concatenate([toks, _toks(3, seed=2)]))
+    assert m is not None
+    entry, blen = m
+    assert blen == 8 and list(entry.buffer) == ids
+    # the old buffer-insert API is refused loudly
+    with pytest.raises(TypeError):
+        store.insert(toks, object())
+
+
+def test_paged_prefix_eviction_respects_live_refs():
+    a = BlockAllocator(10, block=4)
+    # budget of exactly one 2-block entry
+    store = PagedPrefixCache(a, block=4, block_bytes=100, max_bytes=200)
+    first = a.alloc(2)
+    store.insert_blocks(_toks(8, seed=1), first)
+    # a live table still shares the first entry's blocks
+    table = BlockTable(max_blocks=4)
+    table.share(a, first)
+    a.decref(first[0]); a.decref(first[1])  # the slot that computed
+    # them has retired — only store + table refs remain
+    assert a.refs(first[0]) == 2
+    second = a.alloc(2)
+    store.insert_blocks(_toks(8, seed=9), second)  # LRU-evicts `first`
+    assert store.evictions == 1
+    assert store.blocks_released == 2
+    # the evicted entry dropped ITS references, but the live table's
+    # blocks were NOT freed out from under it
+    assert a.refs(first[0]) == 1 and a.refs(first[1]) == 1
+    assert a.free_count == 10 - 4
+    table.release(a)
+    assert a.refs(first[0]) == 0  # now truly free
+    assert a.free_count == 10 - 2
+
+
+def test_paged_prefix_evict_for_reclaims_lru_until_satisfied():
+    a = BlockAllocator(7, block=4)
+    evicted = []
+    store = PagedPrefixCache(a, block=4, block_bytes=100, max_bytes=0,
+                             on_evict=evicted.append)
+    ids1 = a.alloc(2)
+    store.insert_blocks(_toks(8, seed=1), ids1)
+    ids2 = a.alloc(2)
+    store.insert_blocks(_toks(8, seed=2), ids2)
+    a.decref(ids1[0]); a.decref(ids1[1])  # slots retired; store-only
+    a.decref(ids2[0]); a.decref(ids2[1])
+    assert a.free_count == 3
+    # pressure: ask for 2 more free blocks -> one LRU entry goes
+    assert store.evict_for(2)
+    assert a.free_count == 5 and store.evictions == 1
+    assert evicted == [2]
+    # a pinned entry (engine mid-attach) is never pressure-evicted
+    remaining = store._entries[0]
+    store.acquire(remaining)
+    assert not store.evict_for(2)
+    store.release(remaining)
+    assert store.evict_for(2)
+    assert a.free_count == 7

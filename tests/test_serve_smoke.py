@@ -77,6 +77,70 @@ def test_bench_serve_prefix_share_hit_rate_and_flop_reduction(tmp_path):
 
 
 @pytest.mark.slow
+@pytest.mark.parametrize("temperature", [0.0, 0.8])
+def test_serve_smoke_paged_parity(temperature):
+    """Paged KV cache under randomized threaded arrivals on a
+    deliberately tight block pool: lazy block grants, pressure
+    eviction, and preempt/resume must all keep every request
+    token-identical to sequential generate() — greedy and seeded."""
+    import serve_smoke
+
+    stats = serve_smoke.run(requests=10, seed=0, n_slots=4,
+                            temperature=temperature, verbose=False,
+                            paged=True)
+    assert stats["mismatches"] == 0
+    assert stats["decode_traces"] == 1
+    assert stats["serve.requests_completed"] == 10
+    # zero-copy contract: no prefix copy/extract program exists
+    assert stats["prefix_copy_traces"] == 0
+    assert stats["prefix_extract_traces"] == 0
+    # every block reclaimed at drain (only the null block is held)
+    assert stats["block_stats"]["used"] == 1
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("temperature", [0.0, 0.8])
+def test_serve_smoke_paged_prefix_share_parity(temperature):
+    """Zero-copy prefix sharing on the paged engine under threaded
+    arrivals: hits are refcount bumps (no copy program ever compiles),
+    outputs token-identical to BOTH generate() and a dense cache-off
+    engine run of the same jobs."""
+    import serve_smoke
+
+    stats = serve_smoke.run(requests=10, seed=0, n_slots=4,
+                            temperature=temperature, verbose=False,
+                            prefix_share=True, paged=True)
+    assert stats["mismatches"] == 0
+    assert stats["decode_traces"] == 1
+    assert stats["serve.prefix_hits"] > 0
+    assert stats["prefix_copy_traces"] == 0
+    assert stats["prefix_extract_traces"] == 0
+    assert stats["serve.requests_completed"] == 10
+
+
+@pytest.mark.slow
+def test_bench_serve_paged_concurrency_at_fixed_hbm(tmp_path):
+    """The paged acceptance row: at the SAME KV-byte budget, the paged
+    engine holds >= 2x the dense engine's concurrent requests on a
+    mixed long/short workload (dense is OOM-bounded by worst-case
+    max_seq rows), with bit-exact token parity between the engines.
+    TTFT/TPOT deltas are archived, not asserted — this 2-vCPU host's
+    throttle swings single timed runs (the real BENCH_SERVE.json run
+    records them)."""
+    import bench_serve
+
+    row = bench_serve.paged_ab(
+        long_reqs=2, long_len=96, short_reqs=10, short_len=16,
+        tokens=8, slots=12, dense_slots=3, d_model=128, layers=2,
+        max_seq=128, chunk=32,
+        out_path=str(tmp_path / "BENCH_SERVE.json"))
+    assert row["mismatches"] == 0
+    assert row["paged_peak_concurrent"] >= \
+        2 * row["dense_peak_concurrent"], row
+    assert row["compile_counts_paged"]["decode"] == 1
+
+
+@pytest.mark.slow
 def test_bench_serve_batching_beats_sequential(tmp_path):
     """The acceptance bar: >= 1.5x aggregate tokens/sec at 8 concurrent
     requests vs the sequential generate() baseline on CPU, with the
