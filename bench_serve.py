@@ -416,6 +416,232 @@ def paged_ab(long_reqs: int = 2, long_len: int = 160,
     return row
 
 
+def _pctl(vals, q):
+    """Nearest-rank percentile of a small sample (None when empty) —
+    the registry's ONE rank formula, so archived rows can never
+    disagree with the engines' own metric percentiles."""
+    from byteps_tpu.observability.metrics import _nearest_rank
+
+    if not vals:
+        return None
+    return round(_nearest_rank(sorted(vals), q), 4)
+
+
+def router_failover(requests: int = 12, tokens: int = 24,
+                    prompt_len: int = 12, slots: int = 6,
+                    d_model: int = 128, layers: int = 2,
+                    vocab: int = 256, kill_after: int = 2,
+                    out_path: str = "BENCH_SERVE.json",
+                    archive: bool = True):
+    """Failover A/B (serving/router.py): the same threaded workload
+    over 2 replicas, steady-state vs with replica 0 KILLED mid-run
+    (hard connection resets — a crashed process).  Reports TTFT/TPOT
+    p50+p99 and the completed count for both legs: the robustness
+    claim is that the kill leg completes EVERY request token-identical
+    to the greedy generate() reference (failover + deterministic
+    re-dispatch), degrading latency, not correctness."""
+    from byteps_tpu.observability.metrics import MetricsRegistry
+    from byteps_tpu.resilience.policy import RetryPolicy
+    from byteps_tpu.serving import ServeRouter
+    from byteps_tpu.serving import router as rt
+    from byteps_tpu.serving.frontend import serve
+
+    cfg = TransformerConfig(vocab_size=vocab, num_layers=layers,
+                            num_heads=4, d_model=d_model,
+                            d_ff=2 * d_model, max_seq_len=64,
+                            dtype=jnp.float32)
+    model = Transformer(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    prompts = _prompts(requests, prompt_len, vocab)
+    refs = [list(np.asarray(generate(
+        model, variables, p[None], tokens,
+        temperature=0.0)["tokens"])[0]) for p in prompts]
+
+    def run_leg(kill: bool):
+        engines = [ServingEngine(model, variables, n_slots=slots,
+                                 max_seq=64, metrics=ServeMetrics())
+                   for _ in range(2)]
+        for e in engines:
+            # compile outside the timed window: TTFT/TPOT measure
+            # steady-state serving (and the kill must land mid-run,
+            # not mid-compile)
+            e.start()
+            e.submit(prompts[0], 2).result(timeout=120.0)
+        srvs = [serve(e, 0, host="127.0.0.1", in_thread=True)[0]
+                for e in engines]
+        addrs = ["127.0.0.1:%d" % s.server_address[1] for s in srvs]
+        router = ServeRouter(
+            addrs, affinity=False, credits=slots, deadline=60.0,
+            stream_timeout=10.0, registry=MetricsRegistry(),
+            retry=RetryPolicy(max_attempts=8, backoff_base=0.05,
+                              jitter=0.1, deadline=0.0))
+        ttft, tpot, done = [], [], []
+        lock = threading.Lock()
+
+        def worker(i):
+            t0 = time.perf_counter()
+            first = None
+            toks = []
+            try:
+                for tok in router.stream(prompts[i], tokens):
+                    if first is None:
+                        first = time.perf_counter()
+                    toks.append(tok)
+                ok = toks == refs[i]
+            except Exception:
+                ok = False
+            t1 = time.perf_counter()
+            with lock:
+                if first is not None:
+                    ttft.append(first - t0)
+                    if len(toks) > 1:
+                        tpot.append((t1 - first) / (len(toks) - 1))
+                done.append(ok)
+
+        threads = [threading.Thread(target=worker, args=(i,),
+                                    daemon=True)
+                   for i in range(requests)]
+        killer = None
+        if kill:
+            # monitor in the background: the kill must land while the
+            # staggered arrival loop is still feeding requests, so the
+            # tail of the workload actually exercises failover
+            def _killer():
+                while True:
+                    with lock:
+                        if len(done) >= kill_after:
+                            break
+                    time.sleep(0.002)
+                srvs[0].kill()
+
+            killer = threading.Thread(target=_killer, daemon=True)
+            killer.start()
+        try:
+            for t in threads:
+                t.start()
+                time.sleep(0.04)
+            for t in threads:
+                t.join(120.0)
+            if killer is not None:
+                killer.join(60.0)
+            st = router.stats()
+            return {"completed": sum(done), "mismatches":
+                    sum(not ok for ok in done),
+                    "ttft_p50_s": _pctl(ttft, 50),
+                    "ttft_p99_s": _pctl(ttft, 99),
+                    "tpot_p50_s": _pctl(tpot, 50),
+                    "tpot_p99_s": _pctl(tpot, 99),
+                    "failovers": st[rt.FAILOVERS],
+                    "redispatches": st[rt.REDISPATCHES]}
+        finally:
+            router.close()
+            for j, s in enumerate(srvs):
+                if not (kill and j == 0):
+                    try:
+                        s.shutdown()
+                        s.server_close()
+                    except Exception:
+                        pass
+
+    steady = run_leg(False)
+    failover = run_leg(True)
+    row = {"metric": "serve_router_failover", "requests": requests,
+           "tokens": tokens, "replicas": 2, "slots": slots,
+           "d_model": d_model, "layers": layers,
+           "steady": steady, "failover": failover}
+    print(json.dumps(row), flush=True)
+    if archive:
+        _archive_rows([row], out_path)
+    return row
+
+
+def router_affinity(groups: int = 3, per_group: int = 8,
+                    shared_len: int = 64, tail_len: int = 6,
+                    tokens: int = 8, slots: int = 4,
+                    d_model: int = 128, layers: int = 2,
+                    vocab: int = 256, chunk: int = 32,
+                    out_path: str = "BENCH_SERVE.json",
+                    archive: bool = True):
+    """Affinity A/B (serving/router.py): skewed shared-prefix traffic
+    (``groups`` system prompts x ``per_group`` unique tails) over 2
+    prefix-cache replicas, routed prefix-affinity vs round-robin.
+    Requests run one at a time so the measured difference is purely
+    the PLACEMENT policy's effect on cache warmth: affinity pins each
+    group to one replica (1 cold miss per group); round-robin spreads
+    it (1 cold miss per group PER replica) — affinity must win on
+    aggregate prefix-cache hit rate and prefill tokens computed."""
+    from byteps_tpu.observability.metrics import MetricsRegistry
+    from byteps_tpu.serving import ServeRouter
+    from byteps_tpu.serving.frontend import serve
+
+    cfg = TransformerConfig(vocab_size=vocab, num_layers=layers,
+                            num_heads=4, d_model=d_model,
+                            d_ff=2 * d_model, max_seq_len=128,
+                            dtype=jnp.float32)
+    model = Transformer(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    jobs = []
+    for g in range(groups):
+        shared = np.asarray(jax.random.randint(
+            jax.random.PRNGKey(500 + g), (shared_len,), 0, vocab),
+            np.int32)
+        for i in range(per_group):
+            tail = np.asarray(jax.random.randint(
+                jax.random.PRNGKey(900 + g * per_group + i),
+                (tail_len,), 0, vocab), np.int32)
+            jobs.append(np.concatenate([shared, tail]))
+    order = list(range(len(jobs)))
+    import random as _random
+
+    _random.Random(0).shuffle(order)  # interleave the groups
+
+    def run_mode(affinity: bool):
+        engines = [ServingEngine(model, variables, n_slots=slots,
+                                 max_seq=96, chunk=chunk,
+                                 prefix_cache=True, prefix_block=16,
+                                 metrics=ServeMetrics())
+                   for _ in range(2)]
+        srvs = [serve(e, 0, host="127.0.0.1", in_thread=True)[0]
+                for e in engines]
+        addrs = ["127.0.0.1:%d" % s.server_address[1] for s in srvs]
+        router = ServeRouter(addrs, affinity=affinity,
+                             affinity_block=16, credits=slots,
+                             deadline=60.0, stream_timeout=10.0,
+                             registry=MetricsRegistry())
+        try:
+            for i in order:
+                router.generate(jobs[i], tokens)
+            hits = sum(e.prefix.stats()["hits"] for e in engines)
+            misses = sum(e.prefix.stats()["misses"] for e in engines)
+            prefill = sum(e.metrics.get(sm.PREFILL_TOKENS)
+                          for e in engines)
+            return {"hits": hits, "misses": misses,
+                    "hit_rate": round(hits / max(1, hits + misses), 4),
+                    "prefill_tokens": prefill}
+        finally:
+            router.close()
+            for s in srvs:
+                s.shutdown()
+                s.server_close()
+
+    aff = run_mode(True)
+    rr = run_mode(False)
+    row = {"metric": "serve_router_affinity", "groups": groups,
+           "per_group": per_group, "shared_len": shared_len,
+           "replicas": 2, "d_model": d_model, "layers": layers,
+           "hit_rate_affinity": aff["hit_rate"],
+           "hit_rate_rr": rr["hit_rate"],
+           "prefill_tokens_affinity": aff["prefill_tokens"],
+           "prefill_tokens_rr": rr["prefill_tokens"],
+           "affinity": aff, "round_robin": rr}
+    print(json.dumps(row), flush=True)
+    if archive:
+        _archive_rows([row], out_path)
+    return row
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tokens", type=int, default=None,
@@ -441,7 +667,35 @@ def main(argv=None) -> int:
     ap.add_argument("--requests", type=int, default=12)
     ap.add_argument("--chunk", type=int, default=32)
     ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--router-failover", action="store_true",
+                    help="run only the 2-replica router failover A/B "
+                         "(steady vs mid-run replica kill)")
+    ap.add_argument("--router-affinity", action="store_true",
+                    help="run only the router placement A/B (prefix-"
+                         "affinity vs round-robin prefix hit rate)")
     args = ap.parse_args(argv)
+    if args.router_failover:
+        row = router_failover(requests=args.requests,
+                              out_path=args.out,
+                              archive=not args.no_archive)
+        ok = (row["failover"]["completed"] == args.requests
+              and row["failover"]["mismatches"] == 0
+              and row["failover"]["failovers"] >= 1)
+        print(f"router failover: {row['failover']['completed']}/"
+              f"{args.requests} completed across a replica kill, "
+              f"TTFT p99 {row['failover']['ttft_p99_s']}s vs steady "
+              f"{row['steady']['ttft_p99_s']}s "
+              f"({'PASS' if ok else 'FAIL'} all complete, 0 "
+              f"mismatches)")
+        return 0 if ok else 1
+    if args.router_affinity:
+        row = router_affinity(out_path=args.out,
+                              archive=not args.no_archive)
+        ok = row["hit_rate_affinity"] > row["hit_rate_rr"]
+        print(f"router affinity: hit rate {row['hit_rate_affinity']} "
+              f"vs round-robin {row['hit_rate_rr']} "
+              f"({'PASS' if ok else 'FAIL'} affinity wins)")
+        return 0 if ok else 1
     # the two legs have different sweet-spot defaults; explicit flags
     # win in both
     tokens = args.tokens if args.tokens is not None else (

@@ -162,6 +162,29 @@ class Config:
     serve_paged: bool = False     # paged KV cache (block-granular pool)
     serve_block: int = 16         # KV block size in tokens (paged)
     serve_kv_mb: int = 0          # paged KV pool budget (MiB); 0 = dense-equiv
+    # RemoteServeClient wire-read bound: a dead/stalled frontend
+    # surfaces as the typed ServeConnectionError within this, never an
+    # indefinite hang
+    serve_client_timeout_ms: float = 300_000.0
+
+    # --- serving router (byteps_tpu/serving/router.py — the
+    # fault-tolerant tier over N serve replicas: health-checked
+    # failover with deterministic re-dispatch, prefix-affinity
+    # placement, credit backpressure, graceful drain; docs/serving.md
+    # "Router tier") --------------------------------------------------
+    router_port: int = 9100
+    router_replicas: str = ""     # "host:port,host:port" serve replicas
+    router_credits: int = 16      # max in-flight requests per replica
+    router_affinity: bool = True  # prefix-affinity placement (False = RR)
+    router_affinity_block: int = 16  # leading tokens hashed for affinity
+    # per-request re-dispatch deadline: a request that cannot complete
+    # on any replica fails typed (ReplicaLostError) within this bound
+    router_deadline_ms: float = 60_000.0
+    # replica-leg stall bound: no token within this => the leg is
+    # treated as dead and the request re-dispatches
+    router_stream_timeout_ms: float = 30_000.0
+    router_heartbeat_ms: float = 500.0   # replica health-check cadence
+    router_miss_threshold: int = 3       # consecutive misses => DEAD
 
     # --- pipelined wire engine (byteps_tpu/engine/wire.py; the client
     # half of the push/pull pipelining BytePS keeps the wire busy with —
@@ -273,6 +296,22 @@ class Config:
             serve_paged=_env_bool("BYTEPS_SERVE_PAGED"),
             serve_block=_env_int("BYTEPS_SERVE_BLOCK", 16),
             serve_kv_mb=_env_int("BYTEPS_SERVE_KV_MB", 0),
+            serve_client_timeout_ms=_env_float(
+                "BYTEPS_SERVE_CLIENT_TIMEOUT_MS", 300_000.0),
+            router_port=_env_int("BYTEPS_ROUTER_PORT", 9100),
+            router_replicas=_env_str("BYTEPS_ROUTER_REPLICAS", ""),
+            router_credits=_env_int("BYTEPS_ROUTER_CREDITS", 16),
+            router_affinity=_env_bool("BYTEPS_ROUTER_AFFINITY", True),
+            router_affinity_block=_env_int(
+                "BYTEPS_ROUTER_AFFINITY_BLOCK", 16),
+            router_deadline_ms=_env_float(
+                "BYTEPS_ROUTER_DEADLINE_MS", 60_000.0),
+            router_stream_timeout_ms=_env_float(
+                "BYTEPS_ROUTER_STREAM_TIMEOUT_MS", 30_000.0),
+            router_heartbeat_ms=_env_float(
+                "BYTEPS_ROUTER_HEARTBEAT_MS", 500.0),
+            router_miss_threshold=_env_int(
+                "BYTEPS_ROUTER_MISS_THRESHOLD", 3),
             wire_window=_env_int("BYTEPS_WIRE_WINDOW", 8),
             wire_fanout=_env_int("BYTEPS_WIRE_FANOUT", 16),
             transport=_env_str("BYTEPS_TRANSPORT", "auto"),

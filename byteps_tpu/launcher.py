@@ -19,7 +19,12 @@ The same env names keep working so reference run scripts port directly:
                                            "serve" runs the continuous-
                                            batching inference frontend
                                            (serving/frontend.py, knobs
-                                           BYTEPS_SERVE_*); otherwise
+                                           BYTEPS_SERVE_*); "router"
+                                           runs the fault-tolerant
+                                           serving router over
+                                           BYTEPS_ROUTER_REPLICAS
+                                           (serving/router.py, knobs
+                                           BYTEPS_ROUTER_*); otherwise
                                            server/scheduler exit 0 with a
                                            notice (sync mode needs no tier)
   BYTEPS_ENABLE_GDB=1                   -> wrap the command in gdb
@@ -148,6 +153,13 @@ def main(argv=None) -> int:
         from .serving.frontend import serve_from_env
 
         return serve_from_env(env)
+    if role == "router":
+        # fault-tolerant serving router (byteps_tpu/serving/router.py):
+        # health-checked failover over BYTEPS_ROUTER_REPLICAS serve
+        # replicas, speaking the same wire protocol clients already use
+        from .serving.router import router_from_env
+
+        return router_from_env(env)
     if role == "scheduler":
         # obsolete: JAX's coordination service (jax.distributed) replaces
         # the DMLC scheduler rendezvous

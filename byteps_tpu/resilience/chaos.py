@@ -16,7 +16,20 @@ reproducible:
     (exercises timeouts/stragglers);
   * ``"garble_reply"`` — corrupt the reply header so the client's
     decoder errors (exercises the poisoned-socket drop + reconnect);
+  * ``("cut_stream", k)`` — serve-protocol streams only: relay ``k``
+    reply frames, then reset — a replica dying mid-stream at a
+    deterministic token (the router failover trigger, serving/router.py);
   * ``"pass"`` / None — forward untouched.
+
+Serve-protocol awareness (``serve_stream_op=``): the serve frontend's
+STREAM op answers one request frame with a *sequence* of reply frames
+(one per token plus a terminal ``end`` frame — serving/frontend.py).
+When the proxied protocol has such an op, pass its opcode and the proxy
+relays the whole reply sequence per request, applying faults at frame
+granularity (``drop_after`` discards the first reply frame and resets —
+a replica that accepted the request and died before any token crossed
+the wire; ``cut_stream`` cuts after exactly ``k`` tokens).  The default
+(None) keeps the one-request-one-reply PS relay bit-identical.
 
 Faults come from a scripted FIFO (``script(...)`` — consumed one per
 request, exact) and/or seeded random rates (``set_rates`` — reproducible
@@ -79,6 +92,19 @@ def _read_frame(sock: socket.socket) -> bytes:
             + payload)
 
 
+def _frame_meta(frame: bytes) -> Tuple[int, str]:
+    """(op-or-status, name) of an already-read frame — what the serve-
+    stream relay needs to spot the terminal ``end`` frame (and error
+    replies) without re-parsing payloads."""
+    op, nlen = struct.unpack("<BI", frame[:5])
+    off = 5
+    if op & 0x80:
+        (_, elen) = struct.unpack("<BB", frame[5:7])
+        off = 7 + elen
+        op &= 0x7F
+    return op, frame[off:off + nlen].decode(errors="replace")
+
+
 class FaultInjectingProxy:
     """One proxy instance fronts one PS shard; point ``RemoteStore`` at
     ``proxy.addr`` instead of the real server address.
@@ -94,8 +120,12 @@ class FaultInjectingProxy:
 
     def __init__(self, target: str, seed: int = 0, host: str = "127.0.0.1",
                  listen_local: bool = False,
-                 upstream_transport: str = "tcp"):
+                 upstream_transport: str = "tcp",
+                 serve_stream_op: Optional[int] = None):
         self._target = target
+        # opcode whose replies are a frame SEQUENCE (the serve
+        # frontend's STREAM op) — see the module docstring
+        self._serve_stream_op = serve_stream_op
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
         self._script: "collections.deque[Fault]" = collections.deque()
@@ -276,6 +306,49 @@ class FaultInjectingProxy:
                         self._up_kind, self._up_path, self._target,
                         timeout=30.0)
                 upstream.sendall(frame)
+                streaming = (self._serve_stream_op is not None
+                             and (frame[0] & 0x7F)
+                             == self._serve_stream_op)
+                if streaming:
+                    # multi-frame reply (serve STREAM): relay frames
+                    # until the terminal/error frame, applying faults
+                    # at frame granularity.  cut_stream resets after
+                    # exactly k relayed frames — a deterministic
+                    # mid-stream replica death; drop_after (request
+                    # applied, nothing relayed) is cut_stream at 0.
+                    cut_after = None
+                    if isinstance(fault, tuple) and fault[0] == "cut_stream":
+                        self.faults_injected += 1
+                        cut_after = int(fault[1])
+                    elif fault == "drop_after":
+                        self.faults_injected += 1
+                        cut_after = 0
+                    relayed = 0
+                    while True:
+                        reply = _read_frame(upstream)
+                        if cut_after is not None and relayed >= cut_after:
+                            bps_log.debug(
+                                "chaos: cut stream after %d frame(s), "
+                                "request #%d", relayed,
+                                self.requests_seen)
+                            self._reset(client)
+                            return
+                        if fault == "garble_reply" and relayed == 0:
+                            self.faults_injected += 1
+                            reply = (reply[:1] + b"\xff\xff\xff\xff"
+                                     + reply[5:])
+                            try:
+                                client.sendall(reply)
+                            except OSError:
+                                pass
+                            self._reset(client)
+                            return
+                        client.sendall(reply)
+                        relayed += 1
+                        status, rname = _frame_meta(reply)
+                        if status != 0 or rname.startswith("end"):
+                            break
+                    continue
                 reply = _read_frame(upstream)
                 if fault == "drop_after":
                     self.faults_injected += 1

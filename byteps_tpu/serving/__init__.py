@@ -31,6 +31,12 @@ kernels into a *serving engine*:
   * ``frontend`` — an in-process ``ServeClient`` (submit / stream /
     cancel / drain) and a thin length-prefixed TCP frontend launched by
     ``launcher.py`` under the ``serve`` role;
+  * ``router`` — the fault-tolerant scale-out tier over N frontend
+    replicas (``launcher.py`` role ``router``): health-checked
+    failover with deterministic mid-stream re-dispatch (a dead
+    replica's requests resume token-identically on a survivor),
+    prefix-affinity placement, per-replica credit backpressure, and
+    graceful drain — docs/serving.md "Router tier";
   * ``metrics`` — TTFT/TPOT/queue-wait and occupancy/tokens-per-sec
     counters exported through the process ``Tracer``.
 
@@ -46,8 +52,22 @@ from .blocks import (  # noqa: F401
     PagedSlotPool,
 )
 from .engine import Request, RequestState, ServingEngine  # noqa: F401
-from .frontend import ServeClient, serve, serve_from_env  # noqa: F401
+from .frontend import (  # noqa: F401
+    RemoteServeClient,
+    ServeClient,
+    ServeConnectionError,
+    serve,
+    serve_from_env,
+)
 from .metrics import ServeMetrics, get_serve_metrics  # noqa: F401
+from .router import (  # noqa: F401
+    ReplicaLostError,
+    ReplicaState,
+    RouterFrontend,
+    ServeRouter,
+    router_from_env,
+    serve_router,
+)
 from .prefix import (  # noqa: F401
     PagedPrefixCache,
     PrefixCache,

@@ -136,6 +136,10 @@ class Request:
     # eagerly re-admitting it would re-prefill, collide with the same
     # pressure, and be preempted again every tick
     _hold_blocks: int = dataclasses.field(default=0, repr=False)
+    # tokens pre-seeded by a cross-replica resume submit: ANOTHER
+    # engine emitted them, so this engine's latency/token metrics must
+    # not claim them (TPOT would under-read exactly during failover)
+    _resumed_n: int = dataclasses.field(default=0, repr=False)
     # rolling prefix-block digests, computed once at admit and reused
     # for the post-prefill insert (one blake2b per block per pass —
     # recomputing them three times per request sits on the tick thread)
@@ -218,6 +222,19 @@ def _next_bucket(n: int, lo: int, hi: int) -> int:
     while b < n:
         b *= 2
     return min(b, hi)
+
+
+def _resume_key_chain(seed: int, k: int) -> np.ndarray:
+    """Carried sampling key after ``k`` emitted tokens: ``generate()``
+    (and ``_select_token``) split once per emitted token and carry
+    ``split(key)[0]``, so the key state is a pure function of ``(seed,
+    k)`` — which is what makes a dead replica's key state recoverable
+    by any other engine (serving/router.py failover; docs/serving.md
+    "Router tier")."""
+    key = jax.random.PRNGKey(seed)
+    for _ in range(k):
+        key = jax.random.split(key)[0]
+    return np.asarray(key)
 
 
 class ServingEngine:
@@ -313,6 +330,26 @@ class ServingEngine:
                 "could silently diverge from generate().  Serve "
                 "attn_impl='flash' models with chunk=0, "
                 "prefix_cache=False, paged=False.")
+        # cross-replica resume (serving/router.py failover): a
+        # resume-with-prefix submit re-prefills prompt + already-emitted
+        # tokens and continues the parked token/key chain — bit-exact
+        # only when prefill of the emitted region reproduces the K/V the
+        # ORIGINAL run's decode wrote.  kv_quant breaks that (prefill
+        # attends pre-quantization values where decode attended int8),
+        # and a flash-eligible whole-prompt prefill differs from dense
+        # decode in accumulation order — both are refused at submit.
+        if kv_quant:
+            self._resume_unsafe = (
+                "kv_quant: resume prefill attends pre-quantization K/V "
+                "where the original decode attended the quantized values")
+        elif (cfg.attn_impl == "flash" and not cfg.has_sp
+                and self.max_seq >= 128):
+            self._resume_unsafe = (
+                "attn_impl='flash': resume prefill can take the flash "
+                "kernel while the original run's emitted-token K/V came "
+                "from dense decode — accumulation orders differ")
+        else:
+            self._resume_unsafe = ""
         if self.paged:
             self.pool = PagedSlotPool(
                 cfg, n_slots, self.max_seq, block=block,
@@ -690,10 +727,24 @@ class ServingEngine:
     # ------------------------------------------------------------- submit
 
     def submit(self, prompt, max_new_tokens: int, *, seed: int = 0,
-               priority: int = 0) -> Request:
+               priority: int = 0, resume_tokens=None) -> Request:
         """Enqueue a generation request.  Raises ``ValueError`` on an
         infeasible request and ``QueueFullError`` (typed backpressure)
-        when the bounded admission queue is at capacity."""
+        when the bounded admission queue is at capacity.
+
+        ``resume_tokens`` resumes a request another engine already
+        emitted ``k`` tokens for (the router's cross-replica failover,
+        serving/router.py): this engine re-prefills prompt + emitted
+        tokens (position-wise determinism rebuilds the exact K/V the
+        original decode wrote — the PR 9 preempt/resume argument, one
+        engine hop wider), restores the parked next-input token, and —
+        under sampling — recomputes the carried key as the ``k``-fold
+        split chain of ``PRNGKey(seed)``, so the continued stream is
+        token-identical to a never-interrupted run.  The key state is
+        recoverable by construction (a pure function of ``seed`` and
+        ``k``); ``max_new_tokens`` stays the request's TOTAL budget and
+        the resumed tokens count against it (only new tokens are
+        streamed; ``result()`` returns the full sequence)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         T = int(prompt.shape[0])
         if T < 1:
@@ -705,7 +756,45 @@ class ServingEngine:
             raise ValueError(
                 f"prompt ({T}) + max_new_tokens ({max_new_tokens}) "
                 f"exceeds engine max_seq {self.max_seq}")
-        bucket = _next_bucket(T, self.min_prefill_bucket, self.max_seq)
+        resumed: List[int] = ([int(t) for t in resume_tokens]
+                              if resume_tokens is not None else [])
+        if (resumed and self.eos_id is not None
+                and resumed[-1] == self.eos_id):
+            # the stream already ended at EOS on the engine that died:
+            # there is nothing to generate (and decoding past EOS would
+            # emit tokens a never-interrupted run never produces).
+            # Answer an already-finished request — no slot, no prefill,
+            # safe even on configs that refuse recompute-based resume.
+            with self._lock:
+                self._req_seq += 1
+                req = Request(id=self._req_seq, prompt=prompt,
+                              max_new_tokens=max_new_tokens, seed=seed,
+                              priority=priority,
+                              t_submit=time.monotonic())
+                req.tokens = resumed
+                req.state = RequestState.DONE
+                req._out.put(_END)
+                req._done.set()
+            self.metrics.bump(sm.SUBMITTED)
+            self.metrics.bump(sm.COMPLETED)  # 0 tokens generated here
+            return req
+        if resumed:
+            if self._resume_unsafe:
+                raise ValueError(
+                    f"this engine cannot resume a partially-emitted "
+                    f"request bit-exactly ({self._resume_unsafe}); "
+                    f"serve resumable replicas with a dense, "
+                    f"non-flash-prefill config")
+            if max_new_tokens <= len(resumed):
+                raise ValueError(
+                    f"resume carries {len(resumed)} tokens but "
+                    f"max_new_tokens is {max_new_tokens} — nothing "
+                    f"left to generate")
+        # the admission grant is denominated in the padded tokens the
+        # prefill will actually run: prompt plus (on resume) the
+        # emitted tokens minus the parked last one
+        bucket = _next_bucket(T + max(0, len(resumed) - 1),
+                              self.min_prefill_bucket, self.max_seq)
         if self.chunk:
             # the admission grant pays for the FIRST chunk only; each
             # continuation chunk debits the same pool at process time
@@ -728,6 +817,17 @@ class ServingEngine:
             req = Request(id=self._req_seq, prompt=prompt,
                           max_new_tokens=max_new_tokens, seed=seed,
                           priority=priority, t_submit=time.monotonic())
+            if resumed:
+                # pre-seed the emitted tokens and park the resume state
+                # exactly as _preempt would have: _admit then prefills
+                # prompt + tokens[:-1] and the final chunk restores the
+                # parked next-input token and carried key instead of
+                # emitting a fresh "first" token
+                req.tokens = resumed
+                req._resumed_n = len(resumed)
+                req._resume_tok = resumed[-1]
+                if not self.greedy:
+                    req._resume_key = _resume_key_chain(seed, len(resumed))
             if self._trace_rpc:
                 # join the caller's active trace (a submit inside a
                 # traced client op) or mint a fresh id for this request
@@ -951,11 +1051,25 @@ class ServingEngine:
             caches, tok0, nk = fn(self.variables, self.pool.caches,
                                   jnp.asarray(padded), slot, T, key)
             self.pool.caches = caches
+            self.metrics.bump(sm.PREFILL_TOKENS, bucket)
+            self._tick_prefill += bucket
+            if req._resume_tok is not None:
+                # resuming a request another engine emitted tokens for
+                # (router failover): the prefill's sampled token and key
+                # split are discarded — the parked next-input token and
+                # the recomputed carried key continue the original
+                # chain, same discipline as the chunked resume path
+                self._tok = self._tok.at[slot].set(req._resume_tok)
+                if not self.greedy and req._resume_key is not None:
+                    self._keys = self._keys.at[slot].set(
+                        jnp.asarray(req._resume_key))
+                req._resume_tok = None
+                req._resume_key = None
+                self._maybe_insert_prefix(req)
+                return 0
             self._tok = self._tok.at[slot].set(tok0)
             if not self.greedy:
                 self._keys = self._keys.at[slot].set(nk)
-            self.metrics.bump(sm.PREFILL_TOKENS, bucket)
-            self._tick_prefill += bucket
             self._maybe_insert_prefix(req)
             self._emit(req, int(tok0))
             return 1
@@ -1258,8 +1372,8 @@ class ServingEngine:
 
     def _emit(self, req: Request, tok: int) -> None:
         now = time.monotonic()
-        if not req.tokens:
-            req.t_first = now
+        if not req.t_first:  # first token THIS engine emitted (a
+            req.t_first = now  # resumed request pre-seeds req.tokens)
         req.t_last = now
         req.tokens.append(tok)
         req._out.put(tok)
@@ -1291,7 +1405,12 @@ class ServingEngine:
         req._out.put(_END)
         req._done.set()
         if state is RequestState.DONE:
-            n = len(req.tokens)
+            # count only THIS engine's emissions: a resumed request's
+            # pre-seeded tokens belong to the engine that died, and
+            # t_first/t_last span only the local ones — folding the
+            # resumed count in would under-read TPOT exactly during
+            # failover windows and double-count the tier's tokens
+            n = len(req.tokens) - req._resumed_n
             tpot = ((req.t_last - req.t_first) / (n - 1) if n > 1 else None)
             self.metrics.observe_request(
                 queue_wait_s=req.t_admit - req.t_submit,

@@ -6,21 +6,41 @@ raw-numpy-payload framing the PS tier speaks), so a serve process slots
 into the launcher the way a PS shard does: ``DMLC_ROLE=serve`` runs
 :func:`serve_from_env`.
 
-Wire ops (request := the ps_server frame; one request per round trip):
+Wire ops (request := the ps_server frame; one request per round trip,
+except STREAM whose reply is a frame *sequence*):
 
-    0 = SUBMIT  name = JSON {"max_new_tokens", "seed", "priority"}
-                arr  = int32 prompt tokens [T]
+    0 = SUBMIT  name = JSON {"max_new_tokens", "seed", "priority",
+                             "resume"}
+                arr  = int32 prompt tokens [T] (with ``resume`` = k > 0
+                the trailing k entries are tokens another replica
+                already emitted — the router's failover re-dispatch;
+                the engine resumes the stream bit-exactly)
                 reply: status=0, name = request id, arr = int32 tokens;
                 rejections (queue full, infeasible request) come back
                 as status=1 with the typed error's message — the
                 connection survives, clients can back off and retry.
     1 = STATS   reply payload = JSON engine metrics summary
     2 = PING    liveness
+    3 = STREAM  same request frame as SUBMIT; the reply is one frame
+                per emitted token (status=0, name="t", arr=[tok]) and
+                a terminal frame (status=0, name="end", arr = the full
+                token sequence).  A status=1 frame at any point carries
+                a typed error message and ends the stream.  This is
+                what lets the router record how far a stream got before
+                a replica died — the failover re-dispatch resumes from
+                exactly the tokens that crossed the wire.
 
 SUBMIT blocks the *connection* until the request finishes — per-request
-streaming stays in-process (``Request.__iter__``); concurrency across
-the wire comes from concurrent connections, which the engine batches
-into one decode pool (that is the whole point of continuous batching).
+streaming rides OP_STREAM (or stays in-process via
+``Request.__iter__``); concurrency across the wire comes from
+concurrent connections, which the engine batches into one decode pool
+(that is the whole point of continuous batching).
+
+A client socket that disappears mid-STREAM triggers the engine's eager
+``cancel()`` path: the slot (and on paged engines the non-shared KV
+blocks and prefix references) returns to the pool the same tick the
+broken pipe is noticed, not when the abandoned request would have
+finished.
 """
 
 from __future__ import annotations
@@ -36,13 +56,23 @@ from ..common import logging as bps_log
 from ..engine.ps_server import _decode, _encode
 from ..engine.transport import (LocalEndpoints, maybe_nodelay,
                                 resolve_transport, transport_connect)
+from ..engine.wire import hard_reset
 from .engine import Request, ServingEngine
 from .scheduler import AdmissionError
 
-OP_SUBMIT, OP_STATS, OP_PING = range(3)
+OP_SUBMIT, OP_STATS, OP_PING, OP_STREAM = range(4)
 
-__all__ = ["ServeClient", "ServeFrontend", "RemoteServeClient", "serve",
-           "serve_from_env", "OP_SUBMIT", "OP_STATS", "OP_PING"]
+__all__ = ["ServeClient", "ServeFrontend", "RemoteServeClient",
+           "ServeConnectionError", "serve", "serve_from_env",
+           "OP_SUBMIT", "OP_STATS", "OP_PING", "OP_STREAM"]
+
+
+class ServeConnectionError(ConnectionError):
+    """The serve frontend (or router) went away mid-conversation — the
+    connection died or stalled past the client timeout.  Typed so
+    callers can distinguish a dead endpoint (retry elsewhere / fail
+    over) from a replica-side error reply (status=1 ``RuntimeError``,
+    which would recur on retry)."""
 
 
 class ServeClient:
@@ -86,7 +116,62 @@ class ServeClient:
 # ------------------------------------------------------------------ TCP tier
 
 
+def _split_resume(params: dict, arr):
+    """THE wire contract for SUBMIT/STREAM request arrays: ``resume`` =
+    k > 0 marks the trailing k entries as already-emitted tokens (a
+    failover re-dispatch or client retry); the rest is the prompt.
+    Shared by the serve frontend and the router so the two tiers can
+    never silently disagree on the frame layout."""
+    toks = np.asarray(arr, np.int32).reshape(-1)
+    k = int(params.get("resume", 0))
+    return (toks[:-k], toks[-k:]) if k > 0 else (toks, None)
+
+
+def _parse_submit(engine: ServingEngine, name: str, arr):
+    """Decode a SUBMIT/STREAM frame into an engine submit."""
+    params = json.loads(name) if name else {}
+    prompt, resumed = _split_resume(params, arr)
+    req = engine.submit(
+        prompt, int(params.get("max_new_tokens", 16)),
+        seed=int(params.get("seed", 0)),
+        priority=int(params.get("priority", 0)),
+        resume_tokens=resumed)
+    return req, params
+
+
 class _ServeHandler(socketserver.BaseRequestHandler):
+    def setup(self):
+        track = getattr(self.server, "_track_conn", None)
+        if track is not None:
+            track(self.request)
+
+    def _stream(self, engine: ServingEngine, sock, req: Request) -> bool:
+        """Relay ``req``'s tokens as one frame each, then the terminal
+        frame.  Returns False when the CLIENT went away — the caller
+        must stop serving this connection; the request is eagerly
+        cancelled so its slot (and paged KV blocks) free this tick."""
+        try:
+            for tok in req:
+                sock.sendall(_encode(0, "t", np.asarray([tok], np.int32)))
+            sock.sendall(_encode(0, "end",
+                                 np.asarray(req.tokens, np.int32)))
+            return True
+        except RuntimeError as e:
+            # engine died mid-stream: a typed status=1 frame ends the
+            # stream loudly (the iterator already drained to _END)
+            try:
+                sock.sendall(_encode(1, "", None,
+                                     f"{type(e).__name__}: {e}".encode()))
+            except OSError:
+                pass
+            return True
+        except OSError:
+            # client disconnected mid-stream: eager-cancel so the slot
+            # and non-shared blocks are reclaimed same-tick, not when
+            # the abandoned stream would have finished
+            engine.cancel(req)
+            return False
+
     def handle(self):  # one connection, many requests
         engine: ServingEngine = self.server.engine  # type: ignore
         sock = self.request
@@ -99,15 +184,15 @@ class _ServeHandler(socketserver.BaseRequestHandler):
                     return
                 try:
                     if op == OP_SUBMIT:
-                        params = json.loads(name) if name else {}
-                        req = engine.submit(
-                            np.asarray(arr, np.int32).reshape(-1),
-                            int(params.get("max_new_tokens", 16)),
-                            seed=int(params.get("seed", 0)),
-                            priority=int(params.get("priority", 0)))
+                        req, params = _parse_submit(engine, name, arr)
                         toks = req.result(
                             timeout=float(params.get("timeout", 300.0)))
                         reply = _encode(0, str(req.id), toks)
+                    elif op == OP_STREAM:
+                        req, _ = _parse_submit(engine, name, arr)
+                        if not self._stream(engine, sock, req):
+                            return
+                        continue
                     elif op == OP_STATS:
                         payload = json.dumps(
                             {**engine.metrics.summary(),
@@ -152,6 +237,11 @@ class ServeFrontend(socketserver.ThreadingTCPServer):
     def __init__(self, addr, engine: ServingEngine):
         super().__init__(addr, _ServeHandler)
         self.engine = engine
+        # live client sockets, so kill() can die like a crashed process
+        # (sever mid-stream connections, not just stop accepting)
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+        self._killing = False
         # colocated fast path (docs/wire.md "Transports"): advertise a
         # UDS + shm rendezvous next to the TCP port, served by the SAME
         # handler over the same engine, unless pinned to TCP
@@ -170,6 +260,45 @@ class ServeFrontend(socketserver.ThreadingTCPServer):
                     "serve frontend: local transport endpoints "
                     "unavailable (%s); serving TCP only", e)
         engine.start()
+
+    def _track_conn(self, sock) -> None:
+        with self._conns_lock:
+            # the _killing check must share kill()'s critical section:
+            # checked outside it, a handler could pass the check, block
+            # on the lock while kill() swaps the set, and then register
+            # a connection nobody will ever reset
+            if not self._killing:
+                self._conns.add(sock)
+                # drop references the handlers already finished with
+                self._conns = {s for s in self._conns
+                               if s.fileno() != -1}
+                return
+        # a connection that slipped through between kill() and the
+        # listener actually closing (socketserver's shutdown can lag a
+        # poll interval): a dead process serves nobody
+        hard_reset(sock)
+
+    def kill(self) -> None:
+        """Die like a crashed replica (the PSServer.kill discipline):
+        hard-reset every live client connection AND stop accepting, so
+        in-flight streams see ECONNRESET mid-frame — what the router's
+        failover path (and RemoteServeClient's typed
+        ``ServeConnectionError``) must absorb.  Connections are severed
+        FIRST: ``shutdown()`` can wait up to the serve_forever poll
+        interval, and a fast engine would stream a whole request's
+        remaining tokens into the socket in that window — a crash cuts
+        the wire mid-token, so the kill must too (and ``_killing``
+        makes any connection accepted inside that window die
+        unserved).  Chaos/test only."""
+        self._killing = True
+        with self._conns_lock:
+            conns, self._conns = set(self._conns), set()
+        for c in conns:
+            hard_reset(c)
+        self.shutdown()
+        if self.local_endpoints is not None:
+            self.local_endpoints.close(unlink=False)
+        self.server_close()
 
     def server_close(self):
         if self.local_endpoints is not None:
@@ -205,37 +334,140 @@ def serve(engine: ServingEngine, port: int, host: str = "0.0.0.0",
         srv.server_close()
 
 
+def _submit_frame(op: int, prompt, max_new_tokens: int, seed: int,
+                  priority: int, resume) -> bytes:
+    """Encode a SUBMIT/STREAM request: the resume tokens (if any) ride
+    the tail of the token array, counted by the ``resume`` param."""
+    resume = ([] if resume is None
+              else [int(t) for t in resume])
+    params = json.dumps({"max_new_tokens": max_new_tokens, "seed": seed,
+                         "priority": priority, "resume": len(resume)})
+    toks = np.concatenate([np.asarray(prompt, np.int32).reshape(-1),
+                           np.asarray(resume, np.int32)])
+    return _encode(op, params, toks)
+
+
 class RemoteServeClient:
     """Client for the serve frontend (same framing as ``RemoteStore``).
     ``transport`` is resolved per endpoint exactly like the PS
     client's (``auto`` default: UDS/shm for a colocated frontend, TCP
-    otherwise — docs/wire.md "Transports")."""
+    otherwise — docs/wire.md "Transports").
 
-    def __init__(self, addr: str, timeout: float = 300.0,
+    Every wire read is bounded by ``timeout`` (default: the
+    ``BYTEPS_SERVE_CLIENT_TIMEOUT_MS`` knob), and a dead or stalled
+    frontend surfaces as the typed :class:`ServeConnectionError` on
+    ``generate()``/``stream()`` — promptly, never an indefinite hang.
+    One in-flight ``stream()`` per client (it holds the connection)."""
+
+    def __init__(self, addr: str, timeout: Optional[float] = None,
                  transport: Optional[str] = None):
         from ..common.config import get_config
 
+        cfg = get_config()
         kind, path = resolve_transport(
-            addr, transport if transport else get_config().transport)
+            addr, transport if transport else cfg.transport)
+        self.addr = addr
         self.transport = kind
-        self._sock = transport_connect(kind, path, addr, timeout=timeout)
+        self.timeout = (timeout if timeout is not None
+                        else cfg.serve_client_timeout_ms / 1e3)
+        self._sock = transport_connect(kind, path, addr,
+                                       timeout=self.timeout)
         self._lock = threading.Lock()
+        # set when a stream() was abandoned mid-flight: the server
+        # keeps sending that stream's frames, so the connection can no
+        # longer pair requests with replies — every later op would
+        # silently read the orphaned frames as its reply
+        self._poisoned = False
 
-    def _rpc(self, op: int, name: str = "", arr=None):
-        with self._lock:
-            self._sock.sendall(_encode(op, name, arr))
+    def _check_usable(self) -> None:
+        """Call with ``self._lock`` held: the poison flag is written
+        under the same lock (a check outside it could pass while the
+        abandoning thread is still inside the stream's critical
+        section)."""
+        if self._poisoned:
+            raise ServeConnectionError(
+                f"client for {self.addr} abandoned an in-flight "
+                f"stream(); the connection is desynced — open a new "
+                f"RemoteServeClient")
+
+    def _send(self, frame: bytes) -> None:
+        """One frame out, with wire-level death typed (lock held)."""
+        try:
+            self._sock.sendall(frame)
+        except (ConnectionError, OSError) as e:
+            raise ServeConnectionError(
+                f"serve frontend {self.addr} unreachable: {e}") from e
+
+    def _read_frame(self):
+        """One reply frame, with wire-level death typed."""
+        try:
             status, rname, out, payload = _decode(self._sock)
+        except (ConnectionError, OSError, ValueError) as e:
+            raise ServeConnectionError(
+                f"serve frontend {self.addr} died or stalled "
+                f"mid-conversation ({type(e).__name__}: {e}); "
+                f"timeout={self.timeout}s") from e
         if status != 0:
             raise RuntimeError(f"serve error: {payload.decode()!r}")
         return rname, out, payload
 
+    def _rpc(self, op: int, name: str = "", arr=None):
+        with self._lock:
+            self._check_usable()
+            self._send(_encode(op, name, arr))
+            return self._read_frame()
+
     def generate(self, prompt, max_new_tokens: int, *, seed: int = 0,
-                 priority: int = 0) -> np.ndarray:
-        params = json.dumps({"max_new_tokens": max_new_tokens,
-                             "seed": seed, "priority": priority})
-        _, out, _ = self._rpc(OP_SUBMIT, params,
-                              np.asarray(prompt, np.int32).reshape(-1))
+                 priority: int = 0, resume=None) -> np.ndarray:
+        """Blocking submit -> the full token array.  Raises the typed
+        :class:`ServeConnectionError` when the frontend dies first."""
+        with self._lock:
+            self._check_usable()
+            self._send(_submit_frame(OP_SUBMIT, prompt, max_new_tokens,
+                                     seed, priority, resume))
+            _, out, _ = self._read_frame()
         return np.array(out)
+
+    def stream(self, prompt, max_new_tokens: int, *, seed: int = 0,
+               priority: int = 0, resume=None):
+        """Token iterator over the OP_STREAM wire op: yields each token
+        as its frame arrives (``resume`` = already-emitted tokens for a
+        failover re-dispatch — only NEW tokens are streamed back).  A
+        frontend death mid-stream raises :class:`ServeConnectionError`
+        within ``timeout``; a replica-side typed error raises
+        ``RuntimeError`` carrying the error name.  Abandoning the
+        iterator mid-stream POISONS the client (the server keeps
+        sending the orphaned stream's frames, so request/reply pairing
+        is lost) — later calls raise ``ServeConnectionError`` instead
+        of silently reading wrong replies."""
+        with self._lock:
+            self._check_usable()
+            in_flight = False
+            # the poison write happens INSIDE the locked region: a
+            # concurrent caller blocked on the lock must observe it the
+            # moment it gets in, never a window where the abandoning
+            # thread has released the lock but not yet set the flag
+            try:
+                self._send(_submit_frame(OP_STREAM, prompt,
+                                         max_new_tokens, seed,
+                                         priority, resume))
+                in_flight = True
+                while True:
+                    try:
+                        rname, out, _ = self._read_frame()
+                    except RuntimeError:
+                        # a typed status=1 frame TERMINATED the stream
+                        # server-side: the connection stays in sync
+                        in_flight = False
+                        raise
+                    if rname == "t":
+                        yield int(out[0])
+                    else:  # "end" — sequence already yielded piecewise
+                        in_flight = False
+                        return
+            finally:
+                if in_flight:
+                    self._poisoned = True
 
     def stats(self) -> dict:
         _, _, payload = self._rpc(OP_STATS)

@@ -1,0 +1,734 @@
+"""Fault-tolerant serving router: health-checked replica failover with
+deterministic request re-dispatch.
+
+One ``ServingEngine`` is one slot pool on one machine; this tier fans
+client traffic out over N ``ServeFrontend`` replicas while speaking the
+SAME wire protocol clients already use (``frontend.py`` ops — a router
+is indistinguishable from a big frontend).  Robustness is the headline
+(docs/serving.md "Router tier"):
+
+  * **Health-checked replicas.**  A :class:`resilience.FailureDetector`
+    heartbeats every replica over the serve protocol (one-shot OP_PING
+    round trips); replica-leg wire failures feed the same detector so
+    death is noticed at traffic speed.  Replicas move through typed
+    states: HEALTHY -> SUSPECT (missed pings / leg failures, still
+    routable) -> DEAD (excluded, detector watches for recovery) and
+    back (failback re-admission), or HEALTHY -> DRAINING (operator
+    drain — no new placements, in-flight finishes, then retired).
+
+  * **Deterministic re-dispatch.**  The router records every request's
+    prompt and the tokens that crossed the wire so far.  When a replica
+    dies mid-stream, the request is re-submitted to a survivor with the
+    emitted prefix (``resume`` submits — engine.py ``resume_tokens``):
+    the new replica re-prefills prompt + emitted (position-wise
+    determinism rebuilds the exact K/V the dead replica's decode wrote
+    — the PR 9 preempt/resume argument, one machine wider), restores
+    the parked next-input token, and under sampling recomputes the
+    carried key as the k-fold split chain of ``PRNGKey(seed)``.  The
+    spliced stream is token-identical to a never-interrupted run —
+    greedy by construction, seeded because the key state is a pure
+    function of ``(seed, tokens emitted)``.  (If a future sampling
+    scheme made key state non-derivable — external entropy, per-tick
+    reseeding — resume would be inexact; the engine refuses resume
+    loudly for the configs where bit-exactness already cannot hold:
+    ``kv_quant`` and flash-prefill models.)
+
+  * **Bounded, typed failure.**  Queued-but-unstarted requests retry
+    transparently under :class:`resilience.RetryPolicy` backoff; every
+    request carries a deadline, and when no replica can complete it in
+    time it fails with the typed :class:`ReplicaLostError` — never a
+    hang, never a silent drop.  Every wire read is timeout-bounded.
+
+  * **Prefix-affinity placement.**  Requests are steered by a digest of
+    the prompt's leading block (the rolling-hash discipline of
+    serving/prefix.py), so shared-system-prompt traffic lands on the
+    replica whose prefix cache is warm — SGLang-style cache-aware load
+    balancing.  First placement of a prefix group is rendezvous-hashed
+    (HRW: deterministic, stable under replica-set changes) and then
+    sticky; dead primaries remap through the reused
+    :class:`resilience.DegradedModeRouter` (the deterministic
+    next-alive scan every PS worker already agrees on).
+
+  * **Credit backpressure.**  Each replica holds ``credits`` in-flight
+    requests; a full replica sheds to the next-best candidate instead
+    of queueing blind, and total saturation becomes backoff-then-typed
+    failure, not an unbounded queue.
+
+Metrics land on the PR 6 registry (``router.*``): per-replica state and
+in-flight gauges, failover / redispatch / shed / retry counters, and
+the affinity hit rate.  The launcher grows a ``router`` role
+(``DMLC_ROLE=router``, knobs ``BYTEPS_ROUTER_*`` — docs/env.md).
+"""
+
+from __future__ import annotations
+
+import collections
+import enum
+import hashlib
+import itertools
+import json
+import socketserver
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Set
+
+import numpy as np
+
+from ..common import logging as bps_log
+from ..engine.ps_server import _decode, _encode
+from ..engine.transport import maybe_nodelay
+from ..observability.metrics import MetricsRegistry, get_registry
+from ..resilience.detector import FailureDetector
+from ..resilience.policy import RetryPolicy
+from ..resilience.router import DegradedModeRouter
+from .frontend import (OP_PING, OP_STATS, OP_STREAM, OP_SUBMIT,
+                       RemoteServeClient, ServeConnectionError,
+                       _split_resume)
+
+__all__ = ["ReplicaState", "ReplicaLostError", "ServeRouter",
+           "RouterFrontend", "serve_router", "router_from_env"]
+
+# ------------------------------------------------------------- metric names
+REQUESTS = "router.requests"
+COMPLETED = "router.requests_completed"
+FAILED = "router.requests_failed"
+# replica-leg wire failures (the request then re-dispatches or retries)
+FAILOVERS = "router.failovers"
+# re-dispatches that carried an emitted prefix (mid-stream failover)
+REDISPATCHES = "router.redispatches"
+# placements diverted off a full (or replica-side-rejecting) candidate
+SHEDS = "router.sheds"
+# backoff waits (no placeable replica / transient leg failure)
+RETRIES = "router.retries"
+AFFINITY_HITS = "router.affinity_hits"
+AFFINITY_MISSES = "router.affinity_misses"
+DRAINS = "router.drains"
+# labeled per-replica gauges
+REPLICA_STATE = "router.replica_state"      # 0 healthy 1 suspect 2 dead
+REPLICA_INFLIGHT = "router.replica_inflight"  # 3 draining/retired
+
+
+class ReplicaState(enum.Enum):
+    HEALTHY = "healthy"
+    SUSPECT = "suspect"    # missed pings / leg failures; still routable
+    DEAD = "dead"          # excluded; detector watches for failback
+    DRAINING = "draining"  # no new placements; retires when empty
+
+
+_STATE_GAUGE = {ReplicaState.HEALTHY: 0, ReplicaState.SUSPECT: 1,
+                ReplicaState.DEAD: 2, ReplicaState.DRAINING: 3}
+
+
+class ReplicaLostError(RuntimeError):
+    """No replica could complete the request within its deadline: the
+    serving tier lost the replica(s) serving it and ran out of retry
+    budget.  ``emitted`` carries any tokens already streamed (the
+    client saw them; they are valid — the sequence is just truncated)."""
+
+    def __init__(self, msg: str, attempts: int = 0,
+                 emitted: Sequence[int] = ()):
+        self.attempts = attempts
+        self.emitted = list(emitted)
+        super().__init__(msg)
+
+
+class _Replica:
+    __slots__ = ("idx", "addr", "inflight", "suspect", "dead",
+                 "draining", "retired")
+
+    def __init__(self, idx: int, addr: str):
+        self.idx = idx
+        self.addr = addr
+        self.inflight = 0
+        self.suspect = False
+        self.dead = False
+        self.draining = False
+        self.retired = False
+
+    @property
+    def state(self) -> ReplicaState:
+        if self.draining or self.retired:
+            return ReplicaState.DRAINING
+        if self.dead:
+            return ReplicaState.DEAD
+        if self.suspect:
+            return ReplicaState.SUSPECT
+        return ReplicaState.HEALTHY
+
+    @property
+    def placeable(self) -> bool:
+        return not (self.dead or self.draining or self.retired)
+
+
+class ServeRouter:
+    """Fan requests out over N serve replicas; see the module docstring
+    for the failover / placement / backpressure contracts.
+
+    ``registry=None`` binds the process-global metrics registry (what
+    ``/metrics`` and the router's OP_STATS scrape); tests pass a
+    private :class:`MetricsRegistry` to count in isolation.  Call
+    :meth:`start` to run the heartbeat detector (per-request failover
+    works without it — leg failures are detected at traffic speed —
+    but only the detector takes a silent replica out of placement and
+    re-admits it on recovery)."""
+
+    def __init__(self, replicas: Sequence[str], *,
+                 credits: int = 16,
+                 affinity: bool = True,
+                 affinity_block: int = 16,
+                 deadline: float = 60.0,
+                 stream_timeout: float = 30.0,
+                 retry: Optional[RetryPolicy] = None,
+                 heartbeat_interval: float = 0.5,
+                 miss_threshold: int = 3,
+                 ping_timeout: float = 1.0,
+                 registry: Optional[MetricsRegistry] = None):
+        if not replicas:
+            raise ValueError(
+                "ServeRouter needs at least one replica address "
+                "(BYTEPS_ROUTER_REPLICAS=host:port,host:port)")
+        self._replicas = [_Replica(i, a) for i, a in enumerate(replicas)]
+        self.credits = max(1, credits)
+        self.affinity = bool(affinity)
+        self.affinity_block = max(1, affinity_block)
+        self.deadline = deadline
+        self.stream_timeout = stream_timeout
+        # the policy paces attempts; the router's per-request deadline
+        # is passed to should_retry as the bound (the policy's own
+        # deadline field is unused here)
+        self.retry = retry if retry is not None else RetryPolicy(
+            max_attempts=6, backoff_base=0.05, backoff_mult=2.0,
+            backoff_cap=1.0, jitter=0.1, deadline=0.0)
+        self.ping_timeout = ping_timeout
+        self._degraded = DegradedModeRouter(len(self._replicas))
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)  # drain waits here
+        # prefix-group digest -> replica idx (sticky placements),
+        # LRU-bounded so a long-tailed prompt population cannot grow it
+        # without bound
+        self._affinity_map: "collections.OrderedDict[bytes, int]" = \
+            collections.OrderedDict()
+        self._affinity_cap = 4096
+        self._rr = itertools.count()
+        self._registry = registry if registry is not None else get_registry()
+        self._detector = FailureDetector(
+            len(self._replicas), self._ping_replica,
+            interval=heartbeat_interval, miss_threshold=miss_threshold,
+            on_down=self._on_replica_down, on_up=self._on_replica_up)
+        for r in self._replicas:
+            self._gauge_state(r)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "ServeRouter":
+        self._detector.start()
+        return self
+
+    def close(self) -> None:
+        self._detector.stop()
+
+    # -------------------------------------------------------------- metrics
+
+    def _bump(self, name: str, n: int = 1) -> None:
+        self._registry.counter(name, track="router").inc(n)
+
+    def _gauge_state(self, r: _Replica) -> None:
+        self._registry.gauge(REPLICA_STATE, track="router",
+                             replica=r.idx).set(_STATE_GAUGE[r.state])
+
+    def _gauge_inflight(self, r: _Replica) -> None:
+        self._registry.gauge(REPLICA_INFLIGHT, track="router",
+                             replica=r.idx).set(r.inflight)
+
+    # --------------------------------------------------------------- health
+
+    def _ping_replica(self, idx: int) -> bool:
+        """Serve-protocol liveness probe: one short-timeout OP_PING
+        round trip on a fresh connection (never contends with data
+        legs).  Drives the detector's suspect/dead transitions."""
+        r = self._replicas[idx]
+        ok = False
+        try:
+            c = RemoteServeClient(r.addr, timeout=self.ping_timeout)
+            try:
+                ok = c.ping()
+            finally:
+                c.close()
+        except (OSError, ValueError):
+            ok = False
+        if ok:
+            r.suspect = False
+        elif not r.dead:
+            r.suspect = True
+        self._gauge_state(r)
+        return ok
+
+    def _on_replica_down(self, idx: int) -> None:
+        r = self._replicas[idx]
+        r.dead, r.suspect = True, False
+        self._degraded.mark_down(idx)
+        self._gauge_state(r)
+        bps_log.warning("router: replica %d (%s) DEAD", idx, r.addr)
+
+    def _on_replica_up(self, idx: int) -> None:
+        r = self._replicas[idx]
+        if r.draining or r.retired:
+            return  # drained replicas never re-enter placement
+        r.dead = r.suspect = False
+        self._degraded.mark_up(idx)
+        self._gauge_state(r)
+        bps_log.warning("router: replica %d (%s) re-admitted (failback)",
+                        idx, r.addr)
+
+    def _note_leg_failure(self, r: _Replica) -> None:
+        """A data leg to ``r`` died: feed the detector (detection at
+        traffic speed, not ping cadence) and mark the replica suspect
+        until a ping succeeds."""
+        if not r.dead:
+            r.suspect = True
+            self._gauge_state(r)
+        self._detector.report_failure(r.idx)
+
+    # ------------------------------------------------------------ placement
+
+    def _digest(self, prompt: np.ndarray) -> bytes:
+        """Prefix-group key: digest of the prompt's leading affinity
+        block (shorter prompts digest whole) — the rolling-block-hash
+        discipline of serving/prefix.py, truncated to the one block
+        that defines a shared-system-prompt group."""
+        toks = np.ascontiguousarray(prompt[:self.affinity_block])
+        return hashlib.blake2b(toks.tobytes(), digest_size=16).digest()
+
+    def _hrw_order(self, digest: bytes) -> List[int]:
+        """Rendezvous (highest-random-weight) order of ALL replicas for
+        this prefix group: deterministic, and stable under replica-set
+        changes (a dead replica's groups re-home without reshuffling
+        everyone else's)."""
+        scored = sorted(
+            (hashlib.blake2b(digest + r.addr.encode(),
+                             digest_size=8).digest(), r.idx)
+            for r in self._replicas)
+        return [idx for _, idx in reversed(scored)]
+
+    def _acquire(self, digest: bytes,
+                 tried: Set[int]) -> Optional[_Replica]:
+        """Pick a replica for this request and take one credit.  None =
+        nothing placeable right now (dead / draining / full / already
+        tried this round) — the caller backs off and retries.
+
+        Candidate order: the sticky affinity target (or the rendezvous
+        winner) first — remapped around dead replicas by the reused
+        ``DegradedModeRouter`` scan — then the remaining rendezvous
+        order; round-robin mode replaces the whole ranking with a
+        rotating scan."""
+        with self._lock:
+            n = len(self._replicas)
+            mapped = (self._affinity_map.get(digest)
+                      if self.affinity else None)
+            if self.affinity:
+                order = self._hrw_order(digest)
+                primary = mapped if mapped is not None else order[0]
+                try:
+                    first = self._degraded.route(primary)
+                except RuntimeError:
+                    first = primary  # every replica down: scan anyway
+                cands = [first] + [i for i in order if i != first]
+            else:
+                start = next(self._rr) % n
+                cands = [(start + j) % n for j in range(n)]
+            preferred = cands[0]
+            preferred_full = False
+            for idx in cands:
+                r = self._replicas[idx]
+                if idx in tried or not r.placeable:
+                    continue
+                if r.inflight >= self.credits:
+                    if idx == preferred:
+                        preferred_full = True
+                    continue
+                r.inflight += 1
+                self._gauge_inflight(r)
+                if self.affinity:
+                    if mapped == idx:
+                        self._bump(AFFINITY_HITS)
+                    else:
+                        self._bump(AFFINITY_MISSES)
+                    # stickiness survives a transient shed: re-home the
+                    # group only when it has no home or its home is
+                    # gone (dead/draining) — one credit-full blip must
+                    # not move every later request off the warm cache
+                    if (mapped is None
+                            or not self._replicas[mapped].placeable):
+                        self._affinity_map[digest] = idx
+                        while (len(self._affinity_map)
+                                > self._affinity_cap):
+                            self._affinity_map.popitem(last=False)
+                    if digest in self._affinity_map:
+                        self._affinity_map.move_to_end(digest)
+                if preferred_full:
+                    # the best candidate was full: we shed to the
+                    # next-best instead of queueing blind behind it
+                    self._bump(SHEDS)
+                return r
+            return None
+
+    def _release(self, r: _Replica) -> None:
+        with self._lock:
+            r.inflight -= 1
+            self._gauge_inflight(r)
+            self._cv.notify_all()
+
+    # ------------------------------------------------------------- dispatch
+
+    def stream(self, prompt, max_new_tokens: int, *, seed: int = 0,
+               priority: int = 0, deadline: Optional[float] = None,
+               resume=None):
+        """Token iterator: place the request, stream its tokens, and on
+        replica death re-dispatch to a survivor with the emitted prefix
+        — the consumer sees one uninterrupted, token-identical
+        sequence.  Raises :class:`ReplicaLostError` (typed, within the
+        deadline) when the serving tier cannot complete it.
+
+        ``resume`` = tokens the CALLER already holds (a client retrying
+        through the router after its own connection loss — the same
+        wire contract the serve frontend speaks); they count against
+        ``max_new_tokens`` and only new tokens are yielded."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        emitted: List[int] = ([int(t) for t in resume]
+                              if resume is not None else [])
+        if len(emitted) >= max_new_tokens:
+            raise ValueError(
+                f"resume carries {len(emitted)} tokens but "
+                f"max_new_tokens is {max_new_tokens} — nothing left "
+                f"to generate")
+        self._bump(REQUESTS)
+        deadline_ts = time.monotonic() + (
+            deadline if deadline is not None else self.deadline)
+        digest = self._digest(prompt)
+        dispatched = False  # a leg reached a replica at least once
+        tried: Set[int] = set()
+        attempt = 0  # consecutive no-progress attempts (resets on tokens)
+        stalls = 0   # consecutive no-placeable-replica waits
+
+        def _give_up(cause: str, err=None):
+            self._bump(FAILED)
+            e = ReplicaLostError(
+                f"request could not complete on any replica within its "
+                f"deadline: {cause} (attempts without progress: "
+                f"{attempt}, tokens already streamed: {len(emitted)})",
+                attempts=attempt, emitted=emitted)
+            if err is not None:
+                raise e from err
+            raise e
+
+        def _pace(cause: str, err=None):
+            # backoff before the next attempt, deadline- and
+            # attempt-bounded by the RetryPolicy contract
+            nonlocal attempt
+            attempt += 1
+            if not self.retry.should_retry(attempt, deadline_ts):
+                _give_up(cause, err)
+            self._bump(RETRIES)
+            self.retry.sleep(attempt + 1)
+
+        while True:
+            r = self._acquire(digest, tried)
+            if r is None:
+                # no placeable replica this round: clear the per-round
+                # exclusions and wait — states and credits change while
+                # we do.  Saturation is NOT a failed attempt: it is
+                # bounded by the request DEADLINE alone (the RetryPolicy
+                # attempt budget counts replicas actually failing, not
+                # the router waiting its turn for a credit).
+                tried.clear()
+                stalls += 1
+                delay = max(0.005, self.retry.backoff(
+                    min(stalls, self.retry.max_attempts) + 1))
+                if time.monotonic() + delay > deadline_ts:
+                    _give_up("no placeable replica within the deadline "
+                             "(all dead, draining, or at their credit "
+                             "limit)")
+                self._bump(RETRIES)
+                time.sleep(delay)
+                continue
+            stalls = 0
+            leg: Optional[RemoteServeClient] = None
+            try:
+                leg = RemoteServeClient(r.addr,
+                                        timeout=self.stream_timeout)
+                if emitted and dispatched:
+                    # a router-internal re-dispatch (mid-stream
+                    # failover) — caller-supplied resume tokens on the
+                    # FIRST leg are not one
+                    self._bump(REDISPATCHES)
+                dispatched = True
+                for tok in leg.stream(prompt, max_new_tokens, seed=seed,
+                                      priority=priority,
+                                      resume=emitted or None):
+                    emitted.append(int(tok))
+                    attempt = 0
+                    tried.clear()
+                    yield int(tok)
+                self._bump(COMPLETED)
+                return
+            except (ServeConnectionError, OSError) as e:
+                # the replica died or stalled mid-leg (connect refused,
+                # reset mid-stream, no token within stream_timeout):
+                # feed the detector and re-dispatch to a survivor with
+                # the emitted prefix
+                self._note_leg_failure(r)
+                self._bump(FAILOVERS)
+                if len(emitted) >= max_new_tokens:
+                    # the replica died BETWEEN the final token and the
+                    # terminal frame: the stream is already fully
+                    # delivered — completing it is correct, and a
+                    # re-dispatch would be infeasible (nothing left to
+                    # generate)
+                    self._bump(COMPLETED)
+                    return
+                tried.add(r.idx)
+                _pace(f"replica {r.idx} ({r.addr}) lost mid-request: "
+                      f"{e}", e)
+            except RuntimeError as e:
+                msg = str(e)
+                if ("QueueFullError" in msg or "AdmissionError" in msg
+                        or "BlocksExhaustedError" in msg):
+                    # typed replica-side backpressure: shed to the next
+                    # candidate instead of queueing blind behind it
+                    self._bump(SHEDS)
+                    tried.add(r.idx)
+                    _pace(f"replica {r.idx} shedding load: {msg}", e)
+                elif "ValueError" in msg:
+                    # a deterministic client error (infeasible request)
+                    # recurs on every replica — propagate, don't retry
+                    self._bump(FAILED)
+                    raise
+                else:
+                    # replica-side engine failure: that engine is gone
+                    # for this request — treat like a dead replica
+                    self._note_leg_failure(r)
+                    self._bump(FAILOVERS)
+                    if len(emitted) >= max_new_tokens:
+                        self._bump(COMPLETED)  # already fully delivered
+                        return
+                    tried.add(r.idx)
+                    _pace(f"replica {r.idx} failed the request: {msg}",
+                          e)
+            finally:
+                if leg is not None:
+                    leg.close()
+                self._release(r)
+
+    def generate(self, prompt, max_new_tokens: int, *, seed: int = 0,
+                 priority: int = 0, deadline: Optional[float] = None,
+                 resume=None) -> np.ndarray:
+        """Blocking dispatch -> the NEW tokens (the OP_SUBMIT analog
+        of :meth:`stream`; with ``resume`` the caller already holds
+        the prefix, so only the continuation comes back)."""
+        return np.asarray(
+            list(self.stream(prompt, max_new_tokens, seed=seed,
+                             priority=priority, deadline=deadline,
+                             resume=resume)),
+            np.int32)
+
+    # ----------------------------------------------------------------- drain
+
+    def drain(self, idx: int, timeout: Optional[float] = None) -> None:
+        """Gracefully remove replica ``idx``: stop new placements
+        immediately, let in-flight requests finish, then retire it —
+        zero client-visible errors.  Its affinity groups re-home on
+        their next request (rendezvous keeps everyone else's placement
+        stable)."""
+        r = self._replicas[idx]
+        deadline_ts = (time.monotonic() + timeout
+                       if timeout is not None else None)
+        with self._lock:
+            r.draining = True
+            self._gauge_state(r)
+            for d in [d for d, i in self._affinity_map.items()
+                      if i == idx]:
+                del self._affinity_map[d]
+            while r.inflight > 0:
+                remaining = (None if deadline_ts is None
+                             else deadline_ts - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(
+                        f"drain of replica {idx} timed out with "
+                        f"{r.inflight} request(s) still in flight")
+                self._cv.wait(remaining)
+            r.retired = True
+        self._bump(DRAINS)
+        bps_log.info("router: replica %d (%s) drained and retired",
+                     idx, r.addr)
+
+    # ------------------------------------------------------------ inspection
+
+    def replica_states(self) -> List[str]:
+        return [r.state.value for r in self._replicas]
+
+    def stats(self) -> Dict[str, object]:
+        with self._lock:
+            reps = [{"addr": r.addr, "state": r.state.value,
+                     "inflight": r.inflight} for r in self._replicas]
+        out: Dict[str, object] = {"replicas": reps,
+                                  "affinity": self.affinity,
+                                  "credits": self.credits}
+        for name in (REQUESTS, COMPLETED, FAILED, FAILOVERS,
+                     REDISPATCHES, SHEDS, RETRIES, AFFINITY_HITS,
+                     AFFINITY_MISSES, DRAINS):
+            m = self._registry.get(name)
+            out[name] = m.value if m is not None else 0
+        return out
+
+
+# --------------------------------------------------------------- wire tier
+
+
+class _RouterHandler(socketserver.BaseRequestHandler):
+    def handle(self):  # one connection, many requests
+        router: ServeRouter = self.server.router  # type: ignore
+        sock = self.request
+        maybe_nodelay(sock)
+        try:
+            while True:
+                try:
+                    op, name, arr, _ = _decode(sock)
+                except (ConnectionError, OSError):
+                    return
+                try:
+                    if op in (OP_SUBMIT, OP_STREAM):
+                        # same request layout as the serve frontend
+                        # (wire compatibility is the point) — ONE
+                        # definition of the resume-split contract
+                        params = json.loads(name) if name else {}
+                        prompt, resumed = _split_resume(params, arr)
+                        kw = dict(
+                            seed=int(params.get("seed", 0)),
+                            priority=int(params.get("priority", 0)),
+                            resume=resumed)
+                        mnt = int(params.get("max_new_tokens", 16))
+                    if op == OP_SUBMIT:
+                        new = router.generate(prompt, mnt, **kw)
+                        # like the frontend: the reply is the FULL
+                        # sequence, resume prefix included
+                        full = (np.concatenate([resumed, new])
+                                if resumed is not None else new)
+                        reply = _encode(0, "", full)
+                    elif op == OP_STREAM:
+                        gen = router.stream(prompt, mnt, **kw)
+                        emitted: List[int] = ([int(t) for t in resumed]
+                                              if resumed is not None
+                                              else [])
+                        try:
+                            try:
+                                for tok in gen:
+                                    emitted.append(tok)
+                                    sock.sendall(_encode(
+                                        0, "t",
+                                        np.asarray([tok], np.int32)))
+                                sock.sendall(_encode(
+                                    0, "end",
+                                    np.asarray(emitted, np.int32)))
+                            except OSError:
+                                # client went away: closing the
+                                # generator tears the replica leg down,
+                                # which triggers the replica-side eager
+                                # cancel
+                                return
+                        finally:
+                            gen.close()
+                        continue
+                    elif op == OP_STATS:
+                        reply = _encode(
+                            0, "", None,
+                            json.dumps(router.stats()).encode())
+                    elif op == OP_PING:
+                        reply = _encode(0, "", None)
+                    else:
+                        reply = _encode(1, "", None,
+                                        f"bad op {op}".encode())
+                except Exception as e:
+                    # typed errors (ReplicaLostError, replica-side
+                    # rejections) ride the status=1 reply; the
+                    # connection survives
+                    reply = _encode(
+                        1, "", None, f"{type(e).__name__}: {e}".encode())
+                sock.sendall(reply)
+        except Exception as e:  # pragma: no cover - teardown races
+            bps_log.debug("router handler exit: %s", e)
+
+
+class RouterFrontend(socketserver.ThreadingTCPServer):
+    """TCP frontend over a :class:`ServeRouter` — wire-compatible with
+    ``ServeFrontend``, so existing clients point at the router
+    unchanged."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, addr, router: ServeRouter):
+        super().__init__(addr, _RouterHandler)
+        self.router = router
+        router.start()
+
+    def server_close(self):
+        self.router.close()
+        super().server_close()
+
+
+def serve_router(router: ServeRouter, port: int, host: str = "0.0.0.0",
+                 in_thread: bool = False):
+    """Run the router frontend.  ``in_thread=True`` returns
+    ``(server, thread)`` for tests; otherwise blocks (launcher mode)."""
+    srv = RouterFrontend((host, port), router)
+    bps_log.info("byteps_tpu serve router listening on %s:%d over %d "
+                 "replica(s)", host, srv.server_address[1],
+                 len(router._replicas))
+    from ..observability.scrape import maybe_start_metrics_server
+
+    maybe_start_metrics_server(
+        role="router",
+        health_fn=lambda: {"replicas": router.replica_states()})
+    if in_thread:
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        return srv, t
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:  # pragma: no cover
+        pass
+    finally:
+        srv.server_close()
+
+
+def router_from_env(env=None) -> int:
+    """Entry point for the launcher's ``router`` role: build the router
+    from ``BYTEPS_ROUTER_*`` and block on the TCP frontend."""
+    import os
+
+    from ..common.config import get_config, reset_config
+
+    if env is not None:
+        os.environ.update({k: str(v) for k, v in env.items()
+                           if k.startswith(("BYTEPS_", "DMLC_"))})
+    reset_config()
+    cfg = get_config()
+    replicas = [a.strip() for a in cfg.router_replicas.split(",")
+                if a.strip()]
+    if not replicas:
+        raise SystemExit(
+            "byteps_tpu.launcher: the router role needs "
+            "BYTEPS_ROUTER_REPLICAS=host:port,host:port (the serve "
+            "replicas to fan out over)")
+    router = ServeRouter(
+        replicas,
+        credits=cfg.router_credits,
+        affinity=cfg.router_affinity,
+        affinity_block=cfg.router_affinity_block,
+        deadline=cfg.router_deadline_ms / 1e3,
+        stream_timeout=cfg.router_stream_timeout_ms / 1e3,
+        heartbeat_interval=cfg.router_heartbeat_ms / 1e3,
+        miss_threshold=cfg.router_miss_threshold,
+        ping_timeout=cfg.heartbeat_timeout_ms / 1e3)
+    serve_router(router, cfg.router_port)
+    return 0

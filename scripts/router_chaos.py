@@ -1,0 +1,303 @@
+"""Router chaos: N serve replicas behind the fault-tolerant router —
+every request must complete token-identically or fail typed, never hang.
+
+Topology: ``n_replicas`` in-process ``ServingEngine``s behind in-thread
+TCP frontends, each fronted by a serve-stream-aware
+``FaultInjectingProxy`` (resilience/chaos.py) running a seeded random
+fault plan on the replica legs (connection resets before/after the
+request, i.e. both the retry-unstarted and the re-dispatch paths).
+A ``ServeRouter`` with prefix-affinity placement and a live heartbeat
+detector fans randomized threaded traffic out over the proxies.
+
+Legs:
+
+  * **kill** — one long "victim" request is consumed token by token;
+    after 3 tokens the replica actually serving it is killed
+    (``ServeFrontend.kill()``: hard reset on every live connection —
+    a crashed process, not a graceful close).  The victim's spliced
+    stream must be token-identical to sequential ``generate()``
+    (greedy and seeded runs), and the router's failover/redispatch
+    counters must have fired.
+  * **background traffic** — every other request, submitted from
+    threads with jittered arrivals through the same faulty proxies,
+    must either complete token-identically or raise the typed
+    ``ReplicaLostError`` within its deadline.  Threads are joined with
+    a hard timeout: a hung request fails the run.
+  * **drain** — a surviving replica is drained while a fresh batch is
+    in flight: zero client-visible errors, every request
+    token-identical, and the replica retires.
+
+Usage:
+    python scripts/router_chaos.py [--requests 12] [--temperature 0.8]
+                                   [--fault-rate 0.12] [--no-kill]
+                                   [--no-drain] [--seed 0]
+
+Wired into CI as a ``slow``-marked pytest (tests/test_router_chaos.py)
+with a fast deterministic single-failover sibling in tier-1
+(tests/test_serving_router.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def run(requests: int = 12, seed: int = 0, n_replicas: int = 3,
+        temperature: float = 0.0, fault_rate: float = 0.12,
+        kill: bool = True, drain: bool = True,
+        verbose: bool = True) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from byteps_tpu.inference import generate
+    from byteps_tpu.models.transformer import (Transformer,
+                                               TransformerConfig)
+    from byteps_tpu.observability.metrics import MetricsRegistry
+    from byteps_tpu.resilience import FaultInjectingProxy
+    from byteps_tpu.resilience.policy import RetryPolicy
+    from byteps_tpu.serving import (ReplicaLostError, ServeMetrics,
+                                    ServeRouter, ServingEngine)
+    from byteps_tpu.serving import router as rt
+    from byteps_tpu.serving.frontend import OP_STREAM, serve
+
+    cfg = TransformerConfig(vocab_size=61, num_layers=2, num_heads=2,
+                            d_model=32, d_ff=64, max_seq_len=96,
+                            dtype=jnp.float32)
+    model = Transformer(cfg)
+    variables = model.init(jax.random.PRNGKey(1),
+                           jnp.zeros((1, 8), jnp.int32))
+
+    rng = random.Random(seed)
+    shared = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(999), (16,), 0, 61), np.int32)
+    jobs = []
+    for i in range(requests):
+        if i == 0 and kill:
+            T, M = 8, 24  # the long-lived kill victim
+        else:
+            T, M = rng.randint(3, 24), rng.randint(2, 10)
+        tail = np.asarray(jax.random.randint(
+            jax.random.PRNGKey(1000 + i), (T,), 0, 61), np.int32)
+        # half the jobs share a leading block (exercises affinity
+        # placement; the block is 16 tokens = the affinity_block knob)
+        prompt = (np.concatenate([shared, tail]) if i % 2 == 0
+                  else tail)
+        jobs.append((prompt, M, 1000 + i))
+
+    if verbose:
+        print(f"reference: {requests} sequential generate() runs "
+              f"(temperature={temperature})", flush=True)
+    refs = []
+    for prompt, M, s in jobs:
+        kw = ({"rng": jax.random.PRNGKey(s)} if temperature else {})
+        refs.append(list(np.asarray(generate(
+            model, variables, prompt[None], M, temperature=temperature,
+            **kw)["tokens"])[0]))
+
+    engines = [ServingEngine(model, variables, n_slots=4, max_seq=96,
+                             temperature=temperature,
+                             metrics=ServeMetrics())
+               for _ in range(n_replicas)]
+    srvs = [serve(e, 0, host="127.0.0.1", in_thread=True)[0]
+            for e in engines]
+    addrs = ["127.0.0.1:%d" % s.server_address[1] for s in srvs]
+    proxies = [FaultInjectingProxy(a, seed=seed + i,
+                                   serve_stream_op=OP_STREAM)
+               for i, a in enumerate(addrs)]
+    for p in proxies:
+        p.set_rates(drop_before=fault_rate / 2,
+                    drop_after=fault_rate / 2)
+    deadline = 60.0
+    router = ServeRouter(
+        [p.addr for p in proxies], affinity=True, affinity_block=16,
+        credits=4, deadline=deadline, stream_timeout=10.0,
+        heartbeat_interval=0.2, miss_threshold=3, ping_timeout=1.0,
+        retry=RetryPolicy(max_attempts=8, backoff_base=0.05,
+                          backoff_mult=2.0, backoff_cap=0.5,
+                          jitter=0.2, deadline=0.0),
+        registry=MetricsRegistry()).start()
+
+    outcomes = [None] * requests  # "ok" | "mismatch" | typed error name
+    durations = [0.0] * requests
+
+    def submit_one(i):
+        prompt, M, s = jobs[i]
+        t0 = time.monotonic()
+        try:
+            toks = list(router.stream(prompt, M, seed=s))
+            outcomes[i] = "ok" if toks == refs[i] else "mismatch"
+        except ReplicaLostError:
+            outcomes[i] = "ReplicaLostError"
+        except Exception as e:  # anything untyped is a bug
+            outcomes[i] = f"UNTYPED:{type(e).__name__}: {e}"
+        durations[i] = time.monotonic() - t0
+
+    def find_victim_replica(prompt):
+        for j, e in enumerate(engines):
+            for slot in e.pool.active_slots():
+                req = e._slot_req[slot]
+                if req is not None and len(req.prompt) == len(prompt) \
+                        and np.array_equal(req.prompt, prompt):
+                    return j
+        return None
+
+    killed_replica = None
+    threads = []
+    try:
+        # background traffic (jittered threaded arrivals)
+        for i in range(1, requests):
+            t = threading.Thread(target=submit_one, args=(i,),
+                                 daemon=True)
+            threads.append(t)
+            t.start()
+            time.sleep(rng.uniform(0.0, 0.03))
+
+        if kill:
+            # the victim: consume its stream in this thread; after 3
+            # tokens, kill the replica ACTUALLY serving it mid-stream
+            prompt, M, s = jobs[0]
+            t0 = time.monotonic()
+            toks = []
+            try:
+                stream = router.stream(prompt, M, seed=s)
+                for tok in stream:
+                    toks.append(tok)
+                    if len(toks) == 3 and killed_replica is None:
+                        j = find_victim_replica(prompt)
+                        if j is not None:
+                            killed_replica = j
+                            if verbose:
+                                print(f"killing replica {j} mid-stream "
+                                      f"(victim at 3 tokens)",
+                                      flush=True)
+                            srvs[j].kill()
+                outcomes[0] = ("ok" if toks == refs[0] else "mismatch")
+            except ReplicaLostError:
+                outcomes[0] = "ReplicaLostError"
+            durations[0] = time.monotonic() - t0
+        else:
+            submit_one(0)
+
+        hangs = 0
+        join_deadline = time.monotonic() + deadline + 30.0
+        for t in threads:
+            t.join(max(0.1, join_deadline - time.monotonic()))
+            hangs += int(t.is_alive())
+
+        # drain leg: retire a surviving replica under fresh traffic —
+        # zero client-visible errors
+        drain_ok = None
+        if drain:
+            for p in proxies:
+                p.set_rates()  # clean legs: drain must be zero-error
+            survivor = next(i for i in range(n_replicas)
+                            if i != killed_replica
+                            and router._replicas[i].placeable)
+            dn = requests + 4
+            d_out = {}
+
+            def drain_one(i):
+                prompt, M, s = jobs[i % requests]
+                try:
+                    toks = list(router.stream(prompt, M, seed=s))
+                    d_out[i] = (toks == refs[i % requests])
+                except Exception as e:
+                    d_out[i] = f"{type(e).__name__}: {e}"
+
+            d_threads = [threading.Thread(target=drain_one, args=(i,),
+                                          daemon=True)
+                         for i in range(requests, dn)]
+            for t in d_threads:
+                t.start()
+            time.sleep(0.01)
+            router.drain(survivor, timeout=60.0)
+            for t in d_threads:
+                t.join(60.0)
+                hangs += int(t.is_alive())
+            drain_ok = all(v is True for v in d_out.values())
+            if verbose:
+                print(f"drain leg: replica {survivor} retired, "
+                      f"outcomes {d_out}", flush=True)
+
+        st = router.stats()
+        stats = {
+            "requests": requests,
+            "completed": sum(o == "ok" for o in outcomes),
+            "mismatches": sum(o == "mismatch" for o in outcomes),
+            "typed_failures": sum(o == "ReplicaLostError"
+                                  for o in outcomes),
+            "untyped_failures": sum(
+                o is not None and str(o).startswith("UNTYPED")
+                for o in outcomes),
+            "hangs": hangs,
+            "max_duration_s": max(durations),
+            "killed_replica": killed_replica,
+            "drain_ok": drain_ok,
+            "failovers": st[rt.FAILOVERS],
+            "redispatches": st[rt.REDISPATCHES],
+            "sheds": st[rt.SHEDS],
+            "affinity_hits": st[rt.AFFINITY_HITS],
+            "faults_injected": sum(p.faults_injected for p in proxies),
+        }
+        if verbose:
+            print(stats, flush=True)
+
+        # the acceptance contract (ISSUE 11): every request completes
+        # token-identical to the single-engine reference or fails typed
+        # within its deadline — zero hangs, zero silent drops
+        assert stats["mismatches"] == 0, outcomes
+        assert stats["untyped_failures"] == 0, outcomes
+        assert stats["hangs"] == 0
+        assert stats["completed"] + stats["typed_failures"] == requests
+        assert stats["max_duration_s"] < deadline + 30.0
+        if kill:
+            assert killed_replica is not None, \
+                "victim finished before the kill fired — raise its M"
+            assert outcomes[0] == "ok", outcomes[0]
+            assert stats["failovers"] >= 1
+            assert stats["redispatches"] >= 1
+        if drain:
+            assert drain_ok is True
+        return stats
+    finally:
+        router.close()
+        for p in proxies:
+            p.close()
+        for j, s in enumerate(srvs):
+            if j != killed_replica:
+                try:
+                    s.shutdown()
+                    s.server_close()
+                except Exception:
+                    pass
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=12)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--fault-rate", type=float, default=0.12)
+    ap.add_argument("--no-kill", action="store_true")
+    ap.add_argument("--no-drain", action="store_true")
+    args = ap.parse_args(argv)
+    run(requests=args.requests, seed=args.seed,
+        n_replicas=args.replicas, temperature=args.temperature,
+        fault_rate=args.fault_rate, kill=not args.no_kill,
+        drain=not args.no_drain)
+    print("router chaos: OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,76 @@
+"""CI wiring for scripts/router_chaos.py and the router bench legs.
+
+The chaos proof (ISSUE 11 acceptance): N in-process replicas behind
+the router with a fault-injecting proxy on every replica leg, a
+deterministic mid-stream replica kill, and a drain leg — every
+in-flight request either completes token-identical to a single-engine
+``generate()`` reference (greedy AND seeded) or fails with a typed
+error within its deadline; zero hangs, zero silent drops; the drain
+leg sees zero client-visible errors.
+
+All ``slow``-marked; the fast deterministic single-failover sibling
+lives in tier-1 (tests/test_serving_router.py).
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "scripts"))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("temperature", [0.0, 0.8])
+def test_router_chaos_kill_and_drain(temperature):
+    """Mid-stream replica kill at a nonzero proxy fault rate: the
+    victim's spliced stream is token-identical (failover +
+    deterministic re-dispatch fired), background traffic completes or
+    fails typed within its deadline, the drain leg retires a survivor
+    with zero errors."""
+    import router_chaos
+
+    stats = router_chaos.run(requests=12, seed=0, temperature=temperature,
+                             fault_rate=0.12, verbose=False)
+    # run() already asserts the acceptance contract; pin the headline
+    # numbers here so a silent weakening of run() cannot pass
+    assert stats["mismatches"] == 0
+    assert stats["untyped_failures"] == 0
+    assert stats["hangs"] == 0
+    assert stats["completed"] + stats["typed_failures"] == 12
+    assert stats["killed_replica"] is not None
+    assert stats["redispatches"] >= 1
+    assert stats["drain_ok"] is True
+
+
+@pytest.mark.slow
+def test_bench_router_failover_completes_across_kill(tmp_path):
+    """The failover bench row: the kill leg completes EVERY request
+    token-identical (availability degrades to latency, never to
+    correctness) and actually exercised re-dispatch."""
+    import bench_serve
+
+    row = bench_serve.router_failover(
+        requests=10, tokens=16, slots=4,
+        out_path=str(tmp_path / "BENCH_SERVE.json"))
+    assert row["steady"]["completed"] == 10
+    assert row["steady"]["mismatches"] == 0
+    assert row["failover"]["completed"] == 10
+    assert row["failover"]["mismatches"] == 0
+    assert row["failover"]["failovers"] >= 1
+
+
+@pytest.mark.slow
+def test_bench_router_affinity_beats_round_robin(tmp_path):
+    """The placement bench row: on skewed shared-prefix traffic the
+    prefix-affinity router's aggregate cache hit rate must beat
+    round-robin (and be high in absolute terms)."""
+    import bench_serve
+
+    row = bench_serve.router_affinity(
+        out_path=str(tmp_path / "BENCH_SERVE.json"))
+    assert row["hit_rate_affinity"] > row["hit_rate_rr"], row
+    assert row["hit_rate_affinity"] >= 0.8, row
+    assert (row["prefill_tokens_affinity"]
+            < row["prefill_tokens_rr"]), row

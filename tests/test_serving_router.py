@@ -1,0 +1,578 @@
+"""Fault-tolerant serving router (byteps_tpu/serving/router.py).
+
+The correctness anchor is deterministic failover: a replica that dies
+mid-stream must not change a single token — the router re-dispatches
+the request to a survivor with the emitted prefix and the spliced
+stream is token-identical to sequential ``generate()`` (greedy AND
+seeded; docs/serving.md "Router tier").  The rest: prefix-affinity
+placement, credit shedding, graceful drain, typed deadline failure,
+the ``FailureDetector``/``DegradedModeRouter`` reuse over
+serve-protocol pings, and the frontend-side satellites (typed client
+errors on a dead frontend, eager cancel on client disconnect).
+
+Faults are injected deterministically through the serve-stream-aware
+``FaultInjectingProxy`` (``cut_stream`` after exactly k token frames)
+or ``ServeFrontend.kill()`` — no timing-dependent races on the
+assertion paths.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from byteps_tpu.inference import generate
+from byteps_tpu.models.transformer import Transformer, TransformerConfig
+from byteps_tpu.observability.metrics import MetricsRegistry
+from byteps_tpu.resilience import FailureDetector, FaultInjectingProxy
+from byteps_tpu.resilience.policy import RetryPolicy
+from byteps_tpu.serving import (
+    ReplicaLostError,
+    ReplicaState,
+    RemoteServeClient,
+    ServeConnectionError,
+    ServeMetrics,
+    ServeRouter,
+    ServingEngine,
+)
+from byteps_tpu.serving import metrics as sm
+from byteps_tpu.serving import router as rt
+from byteps_tpu.serving.frontend import OP_STREAM, serve
+from byteps_tpu.serving.router import serve_router
+
+M = 8  # tokens per request (shared so generate() compiles once)
+
+
+def _fast_retry(**kw):
+    kw.setdefault("max_attempts", 5)
+    kw.setdefault("backoff_base", 0.02)
+    kw.setdefault("jitter", 0.0)
+    kw.setdefault("backoff_cap", 0.1)
+    kw.setdefault("deadline", 0.0)  # the router deadline is the bound
+    return RetryPolicy(**kw)
+
+
+def _router(addrs, **kw):
+    kw.setdefault("affinity", False)
+    kw.setdefault("stream_timeout", 5.0)
+    kw.setdefault("deadline", 30.0)
+    kw.setdefault("registry", MetricsRegistry())
+    kw.setdefault("retry", _fast_retry())
+    return ServeRouter(addrs, **kw)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = TransformerConfig(vocab_size=61, num_layers=2, num_heads=2,
+                            d_model=32, d_ff=64, max_seq_len=64,
+                            dtype=jnp.float32)
+    model = Transformer(cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(0), (1, 8), 0, 61)
+    variables = model.init(jax.random.PRNGKey(1), toks)
+    return cfg, model, variables
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    return [np.asarray(jax.random.randint(
+        jax.random.PRNGKey(10 + i), (5 + i,), 0, 61), np.int32)
+        for i in range(4)]
+
+
+@pytest.fixture(scope="module")
+def greedy_base(tiny, prompts):
+    _, model, variables = tiny
+    return [np.asarray(generate(model, variables, p[None], M,
+                                temperature=0.0)["tokens"])[0]
+            for p in prompts]
+
+
+@pytest.fixture(scope="module")
+def replica_pair(tiny):
+    """Two greedy serve replicas behind in-thread TCP frontends —
+    the module's default router substrate.  Tests that must KILL a
+    replica build their own disposable one instead."""
+    _, model, variables = tiny
+    engines = [ServingEngine(model, variables, n_slots=4, max_seq=64,
+                             temperature=0.0, metrics=ServeMetrics())
+               for _ in range(2)]
+    srvs = [serve(e, 0, host="127.0.0.1", in_thread=True)[0]
+            for e in engines]
+    addrs = ["127.0.0.1:%d" % s.server_address[1] for s in srvs]
+    yield engines, srvs, addrs
+    for s in srvs:
+        s.shutdown()
+        s.server_close()
+
+
+def _submitted(engine):
+    return engine.metrics.get(sm.SUBMITTED)
+
+
+# -------------------------------------------------------------- basic tier
+
+
+def test_router_parity_and_wire_roundtrip(tiny, prompts, greedy_base,
+                                          replica_pair):
+    """Round-robin router over two live replicas: every request is
+    token-identical to generate(), in-process AND through the router's
+    own wire frontend (blocking and streaming ops)."""
+    engines, _, addrs = replica_pair
+    router = _router(addrs)
+    try:
+        for p, want in zip(prompts[:2], greedy_base[:2]):
+            np.testing.assert_array_equal(router.generate(p, M), want)
+        # streamed, token by token
+        assert list(router.stream(prompts[2], M)) == list(greedy_base[2])
+        # both replicas actually served something (round robin)
+        assert _submitted(engines[0]) > 0 and _submitted(engines[1]) > 0
+        # the wire tier speaks the frontend protocol unchanged
+        srv, _ = serve_router(router, 0, host="127.0.0.1",
+                              in_thread=True)
+        try:
+            c = RemoteServeClient("127.0.0.1:%d" % srv.server_address[1])
+            np.testing.assert_array_equal(
+                c.generate(prompts[3], M), greedy_base[3])
+            assert list(c.stream(prompts[0], M)) == list(greedy_base[0])
+            assert c.ping()
+            st = c.stats()
+            assert len(st["replicas"]) == 2
+            assert st[rt.COMPLETED] >= 5
+            c.close()
+        finally:
+            srv.shutdown()
+            srv.server_close()  # also closes the router (idempotent)
+    finally:
+        router.close()
+
+
+def test_router_failover_mid_stream_greedy(tiny, prompts, greedy_base,
+                                           replica_pair):
+    """THE deterministic single-failover anchor: the replica leg is cut
+    after exactly 3 token frames; the router re-dispatches to the
+    survivor with the emitted prefix and the spliced stream is
+    token-identical to an uninterrupted run."""
+    _, _, addrs = replica_pair
+    proxy = FaultInjectingProxy(addrs[0], serve_stream_op=OP_STREAM)
+    proxy.script(("cut_stream", 3))
+    reg = MetricsRegistry()
+    router = _router([proxy.addr, addrs[1]], registry=reg)
+    try:
+        got = list(router.stream(prompts[0], M))
+        assert got == list(greedy_base[0])
+        st = router.stats()
+        assert st[rt.FAILOVERS] == 1
+        assert st[rt.REDISPATCHES] == 1  # re-dispatch carried 3 tokens
+        assert st[rt.COMPLETED] == 1 and st[rt.FAILED] == 0
+    finally:
+        router.close()
+        proxy.close()
+
+
+def test_router_failover_mid_stream_seeded(tiny, prompts):
+    """Seeded sampling across a mid-stream replica death: the carried
+    key is recomputed as the k-fold split chain of PRNGKey(seed), so
+    the resumed stream continues the exact sample path."""
+    _, model, variables = tiny
+    p = prompts[1]
+    want = np.asarray(generate(model, variables, p[None], M,
+                               temperature=0.8,
+                               rng=jax.random.PRNGKey(7))["tokens"])[0]
+    engines = [ServingEngine(model, variables, n_slots=2, max_seq=64,
+                             temperature=0.8, metrics=ServeMetrics())
+               for _ in range(2)]
+    srvs = [serve(e, 0, host="127.0.0.1", in_thread=True)[0]
+            for e in engines]
+    addrs = ["127.0.0.1:%d" % s.server_address[1] for s in srvs]
+    proxy = FaultInjectingProxy(addrs[0], serve_stream_op=OP_STREAM)
+    proxy.script(("cut_stream", 2))
+    router = _router([proxy.addr, addrs[1]])
+    try:
+        got = list(router.stream(p, M, seed=7))
+        assert got == list(want)
+        assert router.stats()[rt.REDISPATCHES] == 1
+    finally:
+        router.close()
+        proxy.close()
+        for s in srvs:
+            s.shutdown()
+            s.server_close()
+
+
+def test_router_completes_when_cut_after_final_token(tiny, prompts,
+                                                     greedy_base,
+                                                     replica_pair):
+    """A replica dying BETWEEN the final token and the terminal frame
+    must not turn a fully-delivered stream into an error: the router
+    completes it (re-dispatching would be infeasible — nothing left
+    to generate)."""
+    _, _, addrs = replica_pair
+    proxy = FaultInjectingProxy(addrs[0], serve_stream_op=OP_STREAM)
+    proxy.script(("cut_stream", M))  # all M tokens relayed, end cut
+    router = _router([proxy.addr, addrs[1]])
+    try:
+        got = list(router.stream(prompts[0], M))
+        assert got == list(greedy_base[0])
+        st = router.stats()
+        assert st[rt.COMPLETED] == 1 and st[rt.FAILED] == 0
+        assert st[rt.REDISPATCHES] == 0  # nothing was re-generated
+    finally:
+        router.close()
+        proxy.close()
+
+
+def test_router_wire_resume_param_honored(tiny, prompts, greedy_base,
+                                          replica_pair):
+    """Wire compatibility: a client resubmitting through the ROUTER
+    with a resume prefix (the same SUBMIT/STREAM params the serve
+    frontend honors) gets the exact continuation, not a fresh
+    generation over prompt+prefix-as-prompt."""
+    _, _, addrs = replica_pair
+    router = _router(addrs)
+    srv, _ = serve_router(router, 0, host="127.0.0.1", in_thread=True)
+    try:
+        c = RemoteServeClient("127.0.0.1:%d" % srv.server_address[1])
+        want = list(greedy_base[0])
+        k = 3
+        # streamed: only the continuation comes back
+        got = list(c.stream(prompts[0], M, resume=want[:k]))
+        assert got == want[k:], (got, want)
+        # blocking: the reply is the full sequence, like the frontend
+        full = list(c.generate(prompts[0], M, resume=want[:k]))
+        assert full == want, (full, want)
+        c.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_router_affinity_steers_shared_prefix(tiny, replica_pair):
+    """Prefix-affinity placement: requests sharing a leading block all
+    land on ONE replica (whose prefix cache would be warm); distinct
+    prefixes can spread.  The affinity hit counter reflects the sticky
+    placements."""
+    engines, _, addrs = replica_pair
+    shared = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(99), (16,), 0, 61), np.int32)
+    jobs = [np.concatenate([shared, np.asarray([i, i + 1], np.int32)])
+            for i in range(3)]
+    before = [_submitted(e) for e in engines]
+    reg = MetricsRegistry()
+    router = _router(addrs, affinity=True, affinity_block=16,
+                     registry=reg)
+    try:
+        for p in jobs:
+            router.generate(p, 4)
+        after = [_submitted(e) for e in engines]
+        deltas = [a - b for a, b in zip(after, before)]
+        assert sorted(deltas) == [0, 3], deltas  # one replica got all
+        st = router.stats()
+        assert st[rt.AFFINITY_HITS] == 2  # sticky after the first
+        assert st[rt.AFFINITY_MISSES] == 1
+    finally:
+        router.close()
+
+
+def test_router_sheds_to_next_best_when_full(tiny, replica_pair):
+    """Credit backpressure: when the affinity target is at its credit
+    limit, placement sheds to the next-best candidate instead of
+    queueing blind — and the shed counter says so."""
+    _, _, addrs = replica_pair
+    router = _router(addrs, affinity=True, credits=1)
+    try:
+        digest = router._digest(np.arange(16, dtype=np.int32))
+        r1 = router._acquire(digest, set())
+        assert r1 is not None
+        r2 = router._acquire(digest, set())
+        assert r2 is not None and r2.idx != r1.idx
+        assert router.stats()[rt.SHEDS] == 1
+        # both full -> nothing placeable (the dispatch loop then backs
+        # off under RetryPolicy and waits out the request deadline)
+        assert router._acquire(digest, set()) is None
+        router._release(r1)
+        router._release(r2)
+        # the transient shed must NOT have re-homed the group: with
+        # its home free again, placement returns to the warm replica
+        r4 = router._acquire(digest, set())
+        assert r4 is not None and r4.idx == r1.idx
+        router._release(r4)
+    finally:
+        router.close()
+
+
+def test_router_drain_zero_client_visible_errors(tiny, prompts,
+                                                 greedy_base,
+                                                 replica_pair):
+    """drain(): no new placements, in-flight finishes untouched, then
+    the replica retires — zero client-visible errors throughout."""
+    engines, _, addrs = replica_pair
+    router = _router(addrs)
+    try:
+        stream = router.stream(prompts[0], M)
+        first = next(stream)  # in flight on replica 0 (round robin)
+        drained = threading.Event()
+
+        def _drain():
+            router.drain(0, timeout=30.0)
+            drained.set()
+
+        t = threading.Thread(target=_drain, daemon=True)
+        t.start()
+        rest = list(stream)  # finishes normally on the draining replica
+        assert [first] + rest == list(greedy_base[0])
+        assert drained.wait(30.0)
+        assert router._replicas[0].state is ReplicaState.DRAINING
+        before = _submitted(engines[1])
+        for p, want in zip(prompts[1:3], greedy_base[1:3]):
+            np.testing.assert_array_equal(router.generate(p, M), want)
+        # every post-drain placement went to the survivor
+        assert _submitted(engines[1]) - before == 2
+        assert router.stats()[rt.FAILED] == 0
+    finally:
+        router.close()
+
+
+def test_router_saturation_waits_out_the_deadline(tiny, prompts,
+                                                  greedy_base,
+                                                  replica_pair):
+    """Total saturation (every replica at its credit limit) is bounded
+    by the request DEADLINE, not the RetryPolicy attempt budget: a
+    request must keep waiting for a credit long past max_attempts'
+    worth of backoff and complete once one frees."""
+    _, _, addrs = replica_pair
+    router = _router(addrs, credits=1,
+                     retry=_fast_retry(max_attempts=3))
+    try:
+        digest = router._digest(np.asarray(prompts[0], np.int32))
+        held = [router._acquire(digest, set()),
+                router._acquire(digest, set())]
+        assert all(h is not None for h in held)  # tier fully saturated
+        timer = threading.Timer(
+            0.4, lambda: [router._release(h) for h in held])
+        timer.start()
+        t0 = time.monotonic()
+        np.testing.assert_array_equal(
+            router.generate(prompts[0], M, deadline=10.0),
+            greedy_base[0])
+        # it waited for the release (far beyond 3 backoffs ~ 0.1s)
+        assert time.monotonic() - t0 >= 0.35
+        timer.join()
+    finally:
+        router.close()
+
+
+def test_remote_client_abandoned_stream_poisons_not_desyncs(
+        tiny, prompts, replica_pair):
+    """Walking away from stream() mid-flight must not let the next RPC
+    read the orphaned stream's frames as its reply — the client turns
+    typed-unusable instead of silently returning wrong data."""
+    _, _, addrs = replica_pair
+    c = RemoteServeClient(addrs[0], timeout=5.0)
+    it = c.stream(prompts[0], M)
+    assert isinstance(next(it), int)
+    it.close()  # abandon with frames still in flight
+    with pytest.raises(ServeConnectionError, match="desynced"):
+        c.generate(prompts[1], 4)
+    c.close()
+    # a completed stream leaves the connection fully usable
+    c2 = RemoteServeClient(addrs[0], timeout=5.0)
+    list(c2.stream(prompts[0], 4))
+    assert c2.ping()
+    c2.close()
+
+
+def test_router_deadline_typed_failure_never_hangs(tiny, prompts):
+    """No live replica: the request retries under RetryPolicy backoff
+    and fails with the typed ReplicaLostError within its deadline —
+    bounded, never a hang."""
+    router = _router(["127.0.0.1:9"], deadline=1.0)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(ReplicaLostError) as ei:
+            router.generate(prompts[0], M)
+        assert time.monotonic() - t0 < 5.0
+        assert ei.value.emitted == []
+    finally:
+        router.close()
+
+
+# ------------------------------------------- resilience reuse (satellite)
+
+
+def test_failure_detector_serve_protocol_pings(tiny, prompts):
+    """FailureDetector reuse outside the PS tier: suspect->dead needs
+    miss_threshold consecutive serve-protocol ping misses, and the
+    first successful ping re-admits (failback) — driven here
+    deterministically through report_failure/report_success with the
+    REAL serve OP_PING as the probe."""
+    _, model, variables = tiny
+    engine = ServingEngine(model, variables, n_slots=2, max_seq=64,
+                           metrics=ServeMetrics())
+    srv, _ = serve(engine, 0, host="127.0.0.1", in_thread=True)
+    host, port = "127.0.0.1", srv.server_address[1]
+    addr = f"{host}:{port}"
+
+    def serve_ping(_i):
+        try:
+            c = RemoteServeClient(addr, timeout=1.0)
+            try:
+                return c.ping()
+            finally:
+                c.close()
+        except OSError:
+            return False
+
+    downs, ups = [], []
+    det = FailureDetector(1, serve_ping, miss_threshold=2,
+                          on_down=downs.append, on_up=ups.append)
+    assert serve_ping(0) is True  # serve-protocol probe works
+    det.report_success(0)
+    srv.kill()  # dies like a crashed replica (hard resets)
+    assert serve_ping(0) is False
+    det.report_failure(0)
+    assert det.is_up(0)  # one miss = suspect, not dead
+    det.report_failure(0)
+    assert not det.is_up(0) and downs == [0]
+    # failback: a fresh frontend binds the same port; the first
+    # successful ping re-admits the replica
+    engine2 = ServingEngine(model, variables, n_slots=2, max_seq=64,
+                            metrics=ServeMetrics())
+    srv2, _ = serve(engine2, port, host=host, in_thread=True)
+    try:
+        assert serve_ping(0) is True
+        det.report_success(0)
+        assert det.is_up(0) and ups == [0]
+    finally:
+        srv2.shutdown()
+        srv2.server_close()
+
+
+def test_router_down_up_flips_placement(tiny, replica_pair):
+    """The detector callbacks drive the DegradedModeRouter exclusion:
+    DOWN excludes a replica from placement (deterministic next-alive
+    remap), UP re-admits it — and a DRAINING replica is never
+    re-admitted by a late heartbeat success."""
+    _, _, addrs = replica_pair
+    router = _router(addrs, affinity=True)
+    try:
+        digest = router._digest(np.arange(16, dtype=np.int32))
+        primary = router._hrw_order(digest)[0]
+        other = 1 - primary
+        router._on_replica_down(primary)
+        assert router._replicas[primary].state is ReplicaState.DEAD
+        r = router._acquire(digest, set())
+        assert r is not None and r.idx == other
+        router._release(r)
+        router._on_replica_up(primary)
+        assert router._replicas[primary].state is ReplicaState.HEALTHY
+        # drained replicas must ignore failback re-admission
+        router._replicas[other].draining = True
+        router._replicas[other].retired = True
+        router._on_replica_up(other)
+        assert router._replicas[other].state is ReplicaState.DRAINING
+    finally:
+        router.close()
+
+
+# -------------------------------------------- frontend-side (satellites)
+
+
+def test_remote_client_killed_frontend_typed_error(tiny, prompts):
+    """Satellite: a frontend that dies mid-stream surfaces the typed
+    ServeConnectionError on stream() promptly — never a hang; a
+    stalled (blackholed) frontend hits the timeout bound on the
+    blocking path too."""
+    _, model, variables = tiny
+    engine = ServingEngine(model, variables, n_slots=2, max_seq=64,
+                           metrics=ServeMetrics())
+    srv, _ = serve(engine, 0, host="127.0.0.1", in_thread=True)
+    addr = "127.0.0.1:%d" % srv.server_address[1]
+    c = RemoteServeClient(addr, timeout=5.0)
+    it = c.stream(prompts[0], 50)
+    assert isinstance(next(it), int)
+    assert isinstance(next(it), int)
+    # freeze the tick loop first so the stream cannot finish under us,
+    # then die like a crashed replica (hard reset on the live stream)
+    engine.stop()
+    srv.kill()
+    t0 = time.monotonic()
+    with pytest.raises(ServeConnectionError):
+        list(it)
+    assert time.monotonic() - t0 < 5.0
+    c.close()
+    # stalled endpoint: the proxy accepts and swallows; the client's
+    # timeout knob bounds the blocking call with the same typed error
+    proxy = FaultInjectingProxy("127.0.0.1:9")
+    proxy.blackhole(True)
+    c2 = RemoteServeClient(proxy.addr, timeout=1.0)
+    t0 = time.monotonic()
+    with pytest.raises(ServeConnectionError):
+        c2.generate(prompts[0], 4)
+    assert time.monotonic() - t0 < 4.0
+    c2.close()
+    proxy.close()
+
+
+def test_client_disconnect_mid_stream_eager_cancel(tiny, prompts):
+    """Satellite: a client socket that disappears mid-stream triggers
+    the eager cancel() path — the slot and the paged engine's
+    non-shared KV blocks return to the pool promptly (kv_blocks back
+    to baseline), not when the abandoned request would have ended."""
+    _, model, variables = tiny
+    engine = ServingEngine(model, variables, n_slots=2, max_seq=64,
+                           paged=True, block=8, metrics=ServeMetrics())
+    srv, _ = serve(engine, 0, host="127.0.0.1", in_thread=True)
+    addr = "127.0.0.1:%d" % srv.server_address[1]
+    try:
+        baseline_used = engine.pool.block_stats()["used"]
+        c = RemoteServeClient(addr, timeout=5.0)
+        it = c.stream(prompts[0], 40)
+        next(it)
+        next(it)
+        c.close()  # client walks away mid-stream
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            bs = engine.pool.block_stats()
+            if (engine.pool.active_count == 0
+                    and bs["used"] == baseline_used):
+                break
+            time.sleep(0.05)
+        bs = engine.pool.block_stats()
+        assert engine.pool.active_count == 0
+        assert bs["used"] == baseline_used, bs
+        assert engine.metrics.get(sm.CANCELLED) == 1
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_resume_ending_at_eos_completes_without_decoding(tiny, prompts):
+    """A failover re-dispatch whose resume prefix already ends at EOS
+    is DONE — decoding past EOS would emit tokens a never-interrupted
+    run never produces.  No slot, no prefill, immediate result."""
+    _, model, variables = tiny
+    eng = ServingEngine(model, variables, n_slots=2, max_seq=64,
+                        eos_id=7, metrics=ServeMetrics())
+    req = eng.submit(prompts[0], M, resume_tokens=[3, 7])
+    assert req.done
+    assert list(req.result(timeout=5)) == [3, 7]
+    assert eng.pool.active_count == 0 and eng.scheduler.depth == 0
+
+
+def test_resume_submit_refused_on_kv_quant(tiny, prompts):
+    """The honest fallback boundary: engines whose prefill cannot
+    reproduce decode-written K/V bit-exactly refuse resume loudly
+    instead of silently diverging."""
+    _, model, variables = tiny
+    engine = ServingEngine(model, variables, n_slots=2, max_seq=64,
+                           kv_quant=True, metrics=ServeMetrics())
+    with pytest.raises(ValueError, match="resume"):
+        engine.submit(prompts[0], M, resume_tokens=[1, 2])
+    # and a resume that leaves nothing to generate is infeasible
+    engine2 = ServingEngine(model, variables, n_slots=2, max_seq=64,
+                            metrics=ServeMetrics())
+    with pytest.raises(ValueError, match="nothing"):
+        engine2.submit(prompts[0], 2, resume_tokens=[1, 2])
