@@ -416,6 +416,143 @@ def paged_ab(long_reqs: int = 2, long_len: int = 160,
     return row
 
 
+def spec_decode(tokens: int = 96, requests: int = 4, slots: int = 4,
+                prompt_len: int = 12, spec_k: int = 8, ngram: int = 3,
+                reps: int = 3, out_path: str = "BENCH_SERVE.json",
+                archive: bool = True):
+    """Speculative-decoding A/B (serving/spec.py + the engine's verify
+    path): the same greedy workloads run spec-off vs spec-on,
+    interleaved per rep (this host's CPU throttle drifts on the minutes
+    scale), min-of-reps TPOT, **bit-exact token parity asserted** —
+    speculation must multiply tokens/tick, never change the stream.
+
+    Two legs:
+
+      * **repetitive** — a tiny-vocab model whose greedy decode settles
+        into short cycles, the engine-level analog of repetitive
+        JSON/code output (the prompt-lookup sweet spot; a trained model
+        emitting boilerplate behaves the same way).  The acceptance bar
+        is >= 1.5x accepted-tokens-per-decode-tick.
+      * **non-repetitive** — a larger-vocab model emitting effectively
+        random tokens: n-gram matches are rare, the proposer stands
+        down, and nearly every tick runs the plain decode program — the
+        leg bounds speculation's overhead when it cannot help (<= 10%
+        TPOT regression gated in main()).
+    """
+    def build(vocab, d_model, seed):
+        cfg = TransformerConfig(
+            vocab_size=vocab, num_layers=2, num_heads=2, d_model=d_model,
+            d_ff=2 * d_model, max_seq_len=max(256, prompt_len + tokens + 16),
+            dtype=jnp.float32)
+        model = Transformer(cfg)
+        variables = model.init(jax.random.PRNGKey(seed),
+                               jnp.zeros((1, 8), jnp.int32))
+        prompts = [np.asarray(jax.random.randint(
+            jax.random.PRNGKey(300 + i), (prompt_len,), 0, vocab),
+            np.int32) for i in range(requests)]
+        return cfg, model, variables, prompts
+
+    def run_mode(cfg, model, variables, prompts, spec_on: bool):
+        eng = ServingEngine(
+            model, variables, n_slots=min(slots, requests),
+            max_seq=cfg.max_seq_len, temperature=0.0,
+            max_queue=4 * requests,
+            spec_k=(spec_k if spec_on else 0), spec_ngram=ngram,
+            metrics=ServeMetrics())
+        eng.start()
+        eng.submit(prompts[0], tokens)  # warmup: compile off-timer
+        eng.drain(timeout=600)
+        eng.metrics = ServeMetrics()
+        t0 = time.perf_counter()
+        reqs = [eng.submit(p, tokens) for p in prompts]
+        eng.drain(timeout=600)
+        elapsed = time.perf_counter() - t0
+        outs = [np.asarray(r.result()) for r in reqs]
+        summ = eng.metrics.summary()
+        snap = eng.metrics.snapshot()
+        counts = eng.compile_counts()
+        eng.stop()
+        # raise, not assert: these gate the archived row and must
+        # survive python -O.  decode <= 1, not == 1: a leg where every
+        # tick speculated never traces the plain decode program at all
+        # (zero traces is the opposite of a retrace)
+        if counts["decode"] > 1:
+            raise RuntimeError(f"decode retraced: {counts}")
+        if counts["verify"] != counts["verify_buckets"]:
+            # the compile-discipline acceptance criterion: one verify
+            # program per speculation-depth bucket, never per tick
+            raise RuntimeError(f"verify retraced: {counts}")
+        ticks = max(1, snap.get(sm.DECODE_TICKS, 0))
+        return {"elapsed_s": round(elapsed, 4),
+                "tokens_per_tick": round(
+                    snap.get(sm.TOKENS, 0) / ticks, 3),
+                "tpot_p50_ms": round(summ["tpot_p50_s"] * 1e3, 3),
+                "accepted": snap.get(sm.SPEC_ACCEPTED, 0),
+                "proposed": snap.get(sm.SPEC_PROPOSED, 0),
+                "verify_ticks": snap.get(sm.SPEC_VERIFY_TICKS, 0),
+                "decode_ticks": ticks,
+                "compile_counts": dict(counts), "outs": outs}
+
+    def ab_leg(vocab, d_model, seed):
+        built = build(vocab, d_model, seed)
+        offs, ons, mism = [], [], 0
+        for _ in range(max(1, reps)):
+            offs.append(run_mode(*built, spec_on=False))
+            ons.append(run_mode(*built, spec_on=True))
+        for off, on in zip(offs, ons):
+            for a, b in zip(off["outs"], on["outs"]):
+                if not np.array_equal(a, b):
+                    mism += 1
+        off = min(offs, key=lambda r: r["tpot_p50_ms"])
+        on = min(ons, key=lambda r: r["tpot_p50_ms"])
+        return {
+            "vocab": vocab, "d_model": d_model,
+            "tokens_per_tick_off": off["tokens_per_tick"],
+            "tokens_per_tick_on": on["tokens_per_tick"],
+            "tokens_per_tick_ratio": round(
+                on["tokens_per_tick"] / max(off["tokens_per_tick"],
+                                            1e-9), 3),
+            "tpot_p50_off_ms": off["tpot_p50_ms"],
+            "tpot_p50_on_ms": on["tpot_p50_ms"],
+            "tpot_speedup": round(off["tpot_p50_ms"]
+                                  / max(on["tpot_p50_ms"], 1e-9), 3),
+            "accepted_tokens": on["accepted"],
+            "proposed_tokens": on["proposed"],
+            "acceptance_rate": round(
+                on["accepted"] / max(on["proposed"], 1), 4),
+            "verify_ticks": on["verify_ticks"],
+            "decode_ticks_off": off["decode_ticks"],
+            "decode_ticks_on": on["decode_ticks"],
+            "mismatches": mism,
+            "compile_counts_on": on["compile_counts"],
+        }
+
+    # repetitive: tiny vocab -> short greedy cycles; non-repetitive:
+    # effectively random output, the proposer must stand down
+    rep = ab_leg(vocab=3, d_model=16, seed=0)
+    nonrep = ab_leg(vocab=256, d_model=128, seed=1)
+    row = {
+        "metric": "serve_spec_tpot",
+        "backend": jax.default_backend(),
+        "requests": requests, "tokens_per_request": tokens,
+        "slots": min(slots, requests), "prompt_len": prompt_len,
+        "spec_k": spec_k, "ngram": ngram, "reps": reps,
+        "repetitive": rep, "nonrepetitive": nonrep,
+        "mismatches": rep["mismatches"] + nonrep["mismatches"],
+        "nonrep_tpot_overhead": round(
+            nonrep["tpot_p50_on_ms"]
+            / max(nonrep["tpot_p50_off_ms"], 1e-9) - 1.0, 4),
+    }
+    print(json.dumps(row), flush=True)
+    if row["mismatches"]:
+        raise RuntimeError(
+            f"speculation broke token parity: {row['mismatches']} "
+            f"mismatches")
+    if archive:
+        _archive_rows([row], out_path)
+    return row
+
+
 def _pctl(vals, q):
     """Nearest-rank percentile of a small sample (None when empty) —
     the registry's ONE rank formula, so archived rows can never
@@ -673,7 +810,28 @@ def main(argv=None) -> int:
     ap.add_argument("--router-affinity", action="store_true",
                     help="run only the router placement A/B (prefix-"
                          "affinity vs round-robin prefix hit rate)")
+    ap.add_argument("--spec", action="store_true",
+                    help="run only the speculative-decoding A/B "
+                         "(repetitive leg: accepted-tokens/tick + TPOT "
+                         "p50; non-repetitive leg: overhead bound; "
+                         "spec-on vs spec-off interleaved reps, parity "
+                         "asserted)")
     args = ap.parse_args(argv)
+    if args.spec:
+        row = spec_decode(reps=args.reps, out_path=args.out,
+                          archive=not args.no_archive)
+        rep = row["repetitive"]
+        ok = (rep["tokens_per_tick_ratio"] >= 1.5
+              and row["mismatches"] == 0
+              and row["nonrep_tpot_overhead"] <= 0.10)
+        print(f"spec decode: {rep['tokens_per_tick_ratio']}x tokens/"
+              f"tick on the repetitive leg (TPOT p50 "
+              f"{rep['tpot_p50_off_ms']} -> {rep['tpot_p50_on_ms']} ms,"
+              f" {rep['tpot_speedup']}x), non-repetitive TPOT overhead "
+              f"{row['nonrep_tpot_overhead'] * 100:.1f}% "
+              f"({'PASS' if ok else 'FAIL'} >= 1.5x tokens/tick, 0 "
+              f"mismatches, <= 10% overhead)")
+        return 0 if ok else 1
     if args.router_failover:
         row = router_failover(requests=args.requests,
                               out_path=args.out,

@@ -162,6 +162,13 @@ class Config:
     serve_paged: bool = False     # paged KV cache (block-granular pool)
     serve_block: int = 16         # KV block size in tokens (paged)
     serve_kv_mb: int = 0          # paged KV pool budget (MiB); 0 = dense-equiv
+    # speculative decoding (serving/spec.py + engine verify path):
+    # n-gram prompt-lookup proposals verified in one batched pass per
+    # tick — multiplies tokens/tick on repetitive output while staying
+    # bit-exact (docs/serving.md "Speculative decoding")
+    serve_spec: bool = False      # default off
+    serve_spec_k: int = 4         # max proposed tokens (rounds down to 2^n)
+    serve_spec_ngram: int = 3     # longest trailing n-gram matched
     # RemoteServeClient wire-read bound: a dead/stalled frontend
     # surfaces as the typed ServeConnectionError within this, never an
     # indefinite hang
@@ -296,6 +303,9 @@ class Config:
             serve_paged=_env_bool("BYTEPS_SERVE_PAGED"),
             serve_block=_env_int("BYTEPS_SERVE_BLOCK", 16),
             serve_kv_mb=_env_int("BYTEPS_SERVE_KV_MB", 0),
+            serve_spec=_env_bool("BYTEPS_SERVE_SPEC"),
+            serve_spec_k=_env_int("BYTEPS_SERVE_SPEC_K", 4),
+            serve_spec_ngram=_env_int("BYTEPS_SERVE_SPEC_NGRAM", 3),
             serve_client_timeout_ms=_env_float(
                 "BYTEPS_SERVE_CLIENT_TIMEOUT_MS", 300_000.0),
             router_port=_env_int("BYTEPS_ROUTER_PORT", 9100),

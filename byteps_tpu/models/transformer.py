@@ -877,6 +877,37 @@ class Transformer(nn.Module):
         rows = gather_paged_rows(pcaches, table)
         return self.prefill_chunk(tokens, rows, pos, last_idx)
 
+    def verify_tokens(self, tokens, caches, pos):
+        """Speculative-decoding verify: the decode step generalized from
+        1 to ``k + 1`` query positions.  ``tokens [B, k+1]`` is the last
+        emitted token followed by ``k`` proposed continuations, written
+        into the caches at absolute positions ``[pos, pos + k + 1)``
+        (``pos`` a traced scalar), returning the logits at EVERY
+        position (``[B, k+1, vocab]``) so the caller can accept the
+        longest proposal prefix the model itself would have produced.
+
+        This is a pure delegation to :meth:`decode` — one attention
+        implementation — so accepted tokens are bit-exact against the
+        sequential one-token decode by construction: per-position
+        computations are row-independent, attention always runs against
+        the full-length cache buffer under the same causal mask, and
+        masked slots (including the not-yet-accepted speculative
+        positions themselves) contribute exactly-zero probability mass
+        (the ``prefill_chunk`` argument, applied to decode).  Rejected
+        positions' K/V lands beyond the caller's accepted cursor and is
+        overwritten before the mask can ever admit it (docs/serving.md
+        "Speculative decoding")."""
+        return self.decode(tokens, caches, pos)
+
+    def verify_tokens_paged(self, tokens, pcaches, table, pos):
+        """:meth:`verify_tokens` over a paged cache: gather the slot's
+        rows through its block table, verify the ``k + 1`` positions in
+        one pass, return ``(logits [B, k+1, vocab], written rows)`` for
+        the caller's per-position scatter-back (see
+        :meth:`decode_paged`)."""
+        rows = gather_paged_rows(pcaches, table)
+        return self.decode(tokens, rows, pos)
+
 
 def gather_paged_rows(pcaches, table):
     """Assemble one slot's contiguous cache view from paged per-layer

@@ -28,6 +28,12 @@ kernels into a *serving engine*:
     KV prefixes keyed by a rolling token hash: shared system prompts
     are copied device-side into the slot row instead of recomputed
     (bit-exact — the bytes move, nothing is re-derived);
+  * ``spec`` — draft-free speculative decoding (``spec_k > 0``):
+    n-gram prompt-lookup proposals from each request's own history,
+    verified in ONE batched multi-token pass per tick
+    (``Transformer.verify_tokens``) — several tokens per tick on
+    repetitive output, bit-exact by construction because a proposal is
+    accepted only when it equals the token the model itself produced;
   * ``frontend`` — an in-process ``ServeClient`` (submit / stream /
     cancel / drain) and a thin length-prefixed TCP frontend launched by
     ``launcher.py`` under the ``serve`` role;
@@ -65,9 +71,11 @@ from .router import (  # noqa: F401
     ReplicaState,
     RouterFrontend,
     ServeRouter,
+    WeightsMismatchError,
     router_from_env,
     serve_router,
 )
+from .spec import NgramProposer  # noqa: F401
 from .prefix import (  # noqa: F401
     PagedPrefixCache,
     PrefixCache,

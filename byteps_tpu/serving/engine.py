@@ -41,6 +41,23 @@ requests flow through:
     programs are never built), and pool exhaustion evicts prefix
     entries then preempts the newest request back to QUEUED (resume is
     bit-exact; docs/serving.md "Paged KV cache").
+  * **speculative decoding** (``spec_k > 0``, serving/spec.py) — the
+    decode step generalized from 1 to ``k + 1`` query positions: a
+    CPU-side n-gram proposer guesses up to ``k`` continuations from
+    each request's own prompt + emitted history (no draft model), ONE
+    batched ``Transformer.verify_tokens`` pass scores every proposal,
+    and the longest prefix the model itself would have produced is
+    accepted — several tokens per tick on repetitive workloads, one
+    (exactly the plain decode's token) otherwise.  Rejected positions
+    roll back for free: dense, the cursor simply does not advance past
+    the accepted count and the stale K/V beyond it is overwritten
+    before the causal mask can admit it (the freed-rows argument one
+    position wider); paged, writes scatter per position to the slot's
+    own granted blocks only (ungranted span positions aim at the null
+    block and cap acceptance), so shared prefix blocks are never
+    touched.  One verify program per speculation-depth bucket, pinned
+    by ``compile_counts()`` exactly like chunk buckets; ticks where no
+    slot proposes run the plain decode program untouched.
 
 **Determinism / parity contract** (the correctness anchor, pinned by
 tests/test_serving.py and scripts/serve_smoke.py): per request, the
@@ -83,6 +100,7 @@ from .metrics import ServeMetrics, get_serve_metrics
 from .prefix import PagedPrefixCache, PrefixCache, weights_fingerprint
 from .scheduler import ServeScheduler
 from .slots import SlotPool
+from .spec import NgramProposer
 
 __all__ = ["Request", "RequestState", "ServingEngine"]
 
@@ -146,6 +164,12 @@ class Request:
     _prefix_digs: Optional[List[bytes]] = dataclasses.field(
         default=None, repr=False)
     _task: Optional[object] = dataclasses.field(default=None, repr=False)
+    # speculative-decoding proposer context (prompt + emitted tokens,
+    # appended incrementally — rebuilding it per tick would put an
+    # O(T) copy per request on the tick thread; serving/spec.py)
+    _spec_ctx: Optional[np.ndarray] = dataclasses.field(
+        default=None, repr=False)
+    _spec_n: int = dataclasses.field(default=0, repr=False)
     # distributed tracing (docs/observability.md): hex trace id minted
     # at submit when RPC tracing is on; the request's serve span
     # carries it so trace_merge can line serving work up with the PS
@@ -269,6 +293,8 @@ class ServingEngine:
                  block: int = 16,
                  kv_mb: int = 0,
                  kv_blocks: Optional[int] = None,
+                 spec_k: int = 0,
+                 spec_ngram: int = 3,
                  metrics: Optional[ServeMetrics] = None):
         self.model = model
         self.variables = variables
@@ -350,6 +376,42 @@ class ServingEngine:
                 "from dense decode — accumulation orders differ")
         else:
             self._resume_unsafe = ""
+        # speculative decoding (serving/spec.py): depth rounds DOWN to
+        # a power of two so a tick capped by row space can halve its
+        # bucket and stay on the compiled-bucket grid ({1, 2, 4, ...}),
+        # the same discipline as prefill buckets.
+        if spec_k and spec_k > 0:
+            if kv_quant:
+                # conservative twin of the chunk/prefix/paged refusal:
+                # spec's whole value is multi-token parity guarantees,
+                # and the int8 cache's flat-layout decode kernel (tq=1)
+                # vs the dense tq>1 verify is exactly the accumulation-
+                # order divergence that breaks them
+                raise ValueError(
+                    "speculative decoding requires a dense fp KV cache "
+                    "(kv_quant=False): the verify pass must be bit-"
+                    "exact against single-token decode, which the "
+                    "quantized cache paths do not guarantee across "
+                    "query widths")
+            if cache_layout != "grouped":
+                raise ValueError(
+                    f"speculative decoding requires cache_layout="
+                    f"'grouped' (got {cache_layout!r}): a flat-layout "
+                    f"pool decodes tq=1 through the fused Pallas "
+                    f"kernel while the tq>1 verify always runs dense "
+                    f"cached attention — the two differ in "
+                    f"accumulation order, so accepted tokens could "
+                    f"silently diverge from the non-speculative stream")
+            k = 1
+            while k * 2 <= spec_k:
+                k *= 2
+            # ngram floors at 2 (the documented contract): single-token
+            # matches fire on any vocabulary reuse, and every false
+            # proposal costs a widened verify forward — exactly the
+            # overhead bound the non-repetitive bench leg gates
+            self.spec = NgramProposer(k, max(2, spec_ngram))
+        else:
+            self.spec = None
         if self.paged:
             self.pool = PagedSlotPool(
                 cfg, n_slots, self.max_seq, block=block,
@@ -412,12 +474,14 @@ class ServingEngine:
         # sees a harmless miss instead of copying an incompatible
         # buffer and crashing the tick
         self._prefix_salt = b""
+        self._weights_fp: Optional[str] = None
         if self.prefix is not None:
             geom = hashlib.blake2b(digest_size=16)
             for leaf in jax.tree_util.tree_leaves(self.pool.caches):
                 geom.update(f"{leaf.shape[1:]}{leaf.dtype}".encode())
-            self._prefix_salt = (weights_fingerprint(variables)
-                                 + geom.digest())
+            wfp = weights_fingerprint(variables)
+            self._weights_fp = wfp.hex()
+            self._prefix_salt = wfp + geom.digest()
         # credit budget in padded prefill tokens per tick; default = one
         # max-length prefill (or, with chunking on, one chunk — the
         # whole point is bounding per-tick prefill), i.e. "a tick admits
@@ -465,6 +529,7 @@ class ServingEngine:
         self.prefix_copy_traces = 0
         self.prefix_extract_traces = 0
         self.block_cow_traces = 0
+        self.verify_traces = 0
         # donate the cache pool into each step: the pool is replaced by
         # the step's output, and without donation XLA would copy every
         # layer's full [N, S, ...] cache (or [n_blocks, block, ...]
@@ -475,6 +540,10 @@ class ServingEngine:
             donate_argnums=(1,))
         self._prefill_fns: Dict[int, object] = {}
         self._chunk_fns: Dict[int, object] = {}
+        # verify programs, keyed by query width tq = depth + 1 — one
+        # compiled program per speculation-depth bucket (pinned by
+        # compile_counts, the chunk-bucket discipline)
+        self._verify_fns: Dict[int, object] = {}
         self._copy_fn = None
         self._extract_fn = None
         self._cow_fn = None
@@ -585,6 +654,141 @@ class ServingEngine:
             return new_pc, nxt, keys2
 
         return decode_fn
+
+    def _verify_accept(self, props, tmat, kchain, prop_len, active,
+                       tok, keys, budget):
+        """The in-program accept/truncate tail shared by the dense and
+        paged verify steps: given the candidate tokens ``tmat [N, tq]``
+        (the model's pick at every position) and the proposals that fed
+        positions ``1..d`` (``props [N, d]``), compute per slot the
+        accepted count (1 + the leading run of proposals that equal the
+        model's own tokens — position 0 IS the plain decode step, so a
+        slot can never emit less than the non-speculative engine), then
+        truncate at the request's remaining ``budget`` and at the first
+        EOS, and pick the carried next-input token and sampling-key
+        state matching EXACTLY the tokens that will be emitted —
+        rejected positions' key splits are discarded with them, so the
+        per-request chain stays generate()'s (seeded parity by replay).
+        Running on device keeps ``_tok``/``_keys`` resident: the host
+        reads back only the small (tmat, counts) arrays to emit."""
+        d = tmat.shape[1] - 1
+        ok = ((props == tmat[:, :-1])
+              & (jnp.arange(d)[None, :] < prop_len[:, None]))
+        lead = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)
+        m = jnp.minimum(1 + lead, jnp.maximum(budget, 1))
+        if self.eos_id is not None:
+            is_eos = tmat == self.eos_id
+            m = jnp.where(jnp.any(is_eos, axis=1),
+                          jnp.minimum(m, jnp.argmax(is_eos, axis=1) + 1),
+                          m)
+        idx = (m - 1)[:, None]
+        nxt = jnp.take_along_axis(tmat, idx, axis=1)[:, 0]
+        nxt = jnp.where(active, nxt, tok)
+        if self.greedy:
+            nkeys = keys
+        else:
+            nkeys = jnp.take_along_axis(kchain, idx[:, :, None],
+                                        axis=1)[:, 0]
+            nkeys = jnp.where(active[:, None], nkeys, keys)
+        m = jnp.where(active, m, 0)
+        accepted = jnp.where(active, lead, 0)
+        return nxt, nkeys, tmat, m, accepted
+
+    def _verify_fn(self, tq: int):
+        """Jitted speculative verify for one depth bucket (``tq`` =
+        depth + 1 query positions): every slot runs the SAME per-row
+        multi-token decode (``Transformer.verify_tokens`` — one
+        attention implementation), vmapped over the pool exactly like
+        the one-token step, then the in-program accept/truncate tail
+        (``_verify_accept``) picks each slot's emitted prefix and
+        carried token/key state.  Returns ``(caches, tok, keys,
+        tmat, m_emit, accepted)`` — the host emits ``tmat[s, :m_emit]``
+        per active slot and advances cursors; everything else stays on
+        device."""
+        fn = self._verify_fns.get(tq)
+        if fn is not None:
+            return fn
+        model = self.model
+        select = self._select_token
+
+        def one(variables, row, toks, pos, key):
+            rowb = jax.tree_util.tree_map(lambda c: c[None], row)
+            logits, new = model.apply(
+                variables, toks[None, :], rowb, pos,
+                method=Transformer.verify_tokens)
+            ts, ks, k = [], [], key
+            for i in range(tq):
+                t_i, k = select(logits[:, i], k)
+                ts.append(t_i)
+                ks.append(k)
+            return (jax.tree_util.tree_map(lambda c: c[0], new),
+                    jnp.stack(ts), jnp.stack(ks))
+
+        def verify_fn(variables, caches, props, prop_len, pos, active,
+                      tok, keys, budget):
+            self.verify_traces += 1  # trace-time only
+            toks = jnp.concatenate([tok[:, None], props], axis=1)
+            caches, tmat, kchain = jax.vmap(
+                one, in_axes=(None, 0, 0, 0, 0))(
+                    variables, caches, toks, pos, keys)
+            return (caches,) + self._verify_accept(
+                props, tmat, kchain, prop_len, active, tok, keys,
+                budget)
+
+        fn = jax.jit(verify_fn, donate_argnums=(1,))
+        self._verify_fns[tq] = fn
+        return fn
+
+    def _paged_verify_fn(self, tq: int):
+        """Paged twin of ``_verify_fn``: gather each slot's rows through
+        its block table, verify the ``tq``-position span, then scatter
+        the span's fresh K/V back **per position** to the host-computed
+        ``(block, offset)`` targets — touched blocks only, never a
+        whole-block rewrite, so a shared prefix block can never be
+        written (ungranted or masked positions aim at the null block,
+        and ``prop_len`` is pre-capped at the granted coverage so
+        acceptance can never advance a cursor onto an unwritten
+        position)."""
+        fn = self._verify_fns.get(tq)
+        if fn is not None:
+            return fn
+        model = self.model
+        select = self._select_token
+
+        def one(variables, pcaches, table, toks, pos, key):
+            logits, new_rows = model.apply(
+                variables, toks[None, :], pcaches, table, pos,
+                method=Transformer.verify_tokens_paged)
+            ts, ks, k = [], [], key
+            for i in range(tq):
+                t_i, k = select(logits[:, i], k)
+                ts.append(t_i)
+                ks.append(k)
+            # the tq written positions, sliced back out of the gathered
+            # row for the per-position pool scatter below
+            fresh = tuple(
+                {n: jax.lax.dynamic_slice_in_dim(r[n], pos, tq,
+                                                 axis=1)[0]
+                 for n in r} for r in new_rows)
+            return fresh, jnp.stack(ts), jnp.stack(ks)
+
+        def verify_fn(variables, pcaches, props, prop_len, pos, active,
+                      tok, keys, budget, tables, wblk, woff):
+            self.verify_traces += 1  # trace-time only
+            toks = jnp.concatenate([tok[:, None], props], axis=1)
+            fresh, tmat, kchain = jax.vmap(
+                one, in_axes=(None, None, 0, 0, 0, 0))(
+                    variables, pcaches, tables, toks, pos, keys)
+            new_pc = tuple(
+                {n: pc[n].at[wblk, woff].set(fr[n]) for n in pc}
+                for pc, fr in zip(pcaches, fresh))
+            return (new_pc,) + self._verify_accept(
+                props, tmat, kchain, prop_len, active, tok, keys,
+                budget)
+
+        fn = jax.jit(verify_fn, donate_argnums=(1,))
+        self._verify_fns[tq] = fn
+        return fn
 
     def _paged_chunk_fn(self, bucket: int):
         """Paged twin of ``_chunk_fn``: gather the slot's rows through
@@ -1327,6 +1531,13 @@ class ServingEngine:
                       and s not in self._prefilling]
             if not active:
                 return 0
+        if self.spec is not None:
+            props = self._collect_proposals(active)
+            if props:
+                out = self._verify_tick(active, props)
+                if out is not None:
+                    return out
+        self.metrics.bump(sm.DECODE_TICKS)
         pos = np.zeros((n,), np.int32)
         mask = np.zeros((n,), bool)
         for slot in active:
@@ -1368,6 +1579,171 @@ class ServingEngine:
             self.pool.advance(slot)
             self._emit(req, int(nxt_host[slot]))
             emitted += 1
+        return emitted
+
+    def _collect_proposals(self, active: List[int]) -> Dict[int, List[int]]:
+        """CPU-side prompt-lookup pass: per active slot, match the
+        request's trailing n-gram against its own prompt + emitted
+        history and propose up to ``k`` continuations (serving/spec.py).
+        Proposals are capped at the slot's remaining row space and the
+        request's remaining token budget minus one — tokens past either
+        could never be emitted, so verifying them would be pure waste.
+        Empty when nothing matched anywhere: the tick then runs the
+        plain decode program, paying zero verify overhead."""
+        props: Dict[int, List[int]] = {}
+        S = self.max_seq
+        for slot in active:
+            req = self._slot_req[slot]
+            if req is None or not req.tokens:
+                continue
+            cap = min(S - self.pool.pos[slot] - 1,
+                      req.max_new_tokens - len(req.tokens) - 1)
+            if cap < 1:
+                continue
+            buf = req._spec_ctx
+            P = int(req.prompt.shape[0])
+            if buf is None:
+                buf = np.empty(P + req.max_new_tokens, np.int32)
+                buf[:P] = req.prompt
+                req._spec_ctx = buf
+                req._spec_n = P
+            k = len(req.tokens)
+            have = req._spec_n - P
+            if k > have:
+                buf[P + have:P + k] = req.tokens[have:]
+                req._spec_n = P + k
+            p = self.spec.propose(buf[:req._spec_n], cap)
+            if p:
+                # SPEC_PROPOSED is bumped in _verify_tick from the
+                # post-truncation lengths actually fed to the verifier
+                # (a depth-bucket halving or paged coverage clip — or a
+                # row-cap fallback to plain decode — drops tokens that
+                # must not inflate the acceptance-rate denominator)
+                props[slot] = p
+        return props
+
+    def _verify_tick(self, active: List[int],
+                     props: Dict[int, List[int]]) -> Optional[int]:
+        """One speculative tick: every slot rides a single ``tq = d + 1``
+        verify pass (``d`` = this tick's depth bucket), and each active
+        slot accepts the longest prefix of its proposals the model
+        itself produced — at least one token (position 0 IS the plain
+        decode step, so a tick can never emit less than the
+        non-speculative engine).  Returns None when the depth bucket
+        cannot fit every slot's row (the caller falls back to the plain
+        decode program).
+
+        Rollback of rejected positions is free by construction.  Dense:
+        the cursor advances only past accepted tokens; the rejected
+        span's K/V sits beyond it, never attended before the request's
+        own later writes replace it (the freed-rows argument).  Paged:
+        the scatter targets each span position's own granted block
+        (host-computed), ungranted positions aim at the null block and
+        cap acceptance, so shared prefix blocks are untouchable."""
+        n = self.pool.n_slots
+        S = self.max_seq
+        d = _next_bucket(max(len(p) for p in props.values()), 1,
+                         self.spec.k)
+        # row cap: every slot whose write rides the program — active,
+        # and (dense) PREFILLING slots whose masked garbage write is
+        # aimed at their cursor — must fit [pos, pos + tq) inside its
+        # row, or dynamic_update_slice would clamp the write leftward
+        # over real K/V.  Halving stays on the compiled bucket grid.
+        cap = S
+        for slot in range(n):
+            if self._slot_req[slot] is not None and (
+                    not self.paged or slot not in self._prefilling):
+                cap = min(cap, S - self.pool.pos[slot] - 1)
+        while d > cap and d > 1:
+            d //= 2
+        if d > cap:
+            return None
+        tq = d + 1
+        pmat = np.full((n, d), self.pad_id, np.int32)
+        plen = np.zeros((n,), np.int32)
+        posv = np.zeros((n,), np.int32)
+        mask = np.zeros((n,), bool)
+        budget = np.ones((n,), np.int32)
+        for slot in active:
+            req = self._slot_req[slot]
+            posv[slot] = self.pool.pos[slot]
+            mask[slot] = True
+            budget[slot] = req.max_new_tokens - len(req.tokens)
+            p = props.get(slot)
+            if p:
+                m = min(len(p), d)
+                pmat[slot, :m] = p[:m]
+                plen[slot] = m
+        if self.paged:
+            blk = self.pool.block
+            null = self.pool.null_block
+            wblk = np.full((n, tq), null, np.int32)
+            woff = np.zeros((n, tq), np.int32)
+            for slot in active:
+                # span grant, best-effort: speculation must never evict
+                # prefix entries or preempt live requests just to hold
+                # guess-width — on exhaustion acceptance simply caps at
+                # the granted coverage (>= pos + 1, ensured above)
+                want = int(posv[slot]) + 1 + int(plen[slot])
+                try:
+                    self.pool.ensure_blocks(slot, min(want, S))
+                except BlocksExhaustedError:
+                    pass
+                table = self.pool.tables[slot].blocks
+                cov = len(table) * blk - int(posv[slot])
+                # a proposal whose acceptance would advance the cursor
+                # onto an ungranted (null-aimed, unwritten) position is
+                # clipped BEFORE the verify, so the in-program accept
+                # can never outrun the granted coverage
+                plen[slot] = min(int(plen[slot]), cov - 1)
+                for j in range(min(tq, cov)):
+                    p_ = int(posv[slot]) + j
+                    wblk[slot, j] = table[p_ // blk]
+                    woff[slot, j] = p_ % blk
+            fn = self._paged_verify_fn(tq)
+            out = fn(self.variables, self.pool.caches,
+                     jnp.asarray(pmat), jnp.asarray(plen),
+                     jnp.asarray(posv), jnp.asarray(mask), self._tok,
+                     self._keys, jnp.asarray(budget),
+                     self.pool.tables_device(), jnp.asarray(wblk),
+                     jnp.asarray(woff))
+        else:
+            # PREFILLING slots' masked garbage span aims at their
+            # cursor, same discipline as the one-token step (the span
+            # fits by the row cap above)
+            for slot in self._prefilling:
+                posv[slot] = self.pool.pos[slot]
+            fn = self._verify_fn(tq)
+            out = fn(self.variables, self.pool.caches,
+                     jnp.asarray(pmat), jnp.asarray(plen),
+                     jnp.asarray(posv), jnp.asarray(mask), self._tok,
+                     self._keys, jnp.asarray(budget))
+        caches, self._tok, self._keys, tmat, m_emit, lead = out
+        self.pool.caches = caches
+        # ONE host transfer for everything the emit loop needs — three
+        # separate np.asarray calls would block three times
+        tmat_h, me_h, lead_h = jax.device_get((tmat, m_emit, lead))
+        emitted = 0
+        accepted = 0
+        for slot in active:
+            req = self._slot_req[slot]
+            if req is None:
+                continue
+            n_emit = int(me_h[slot])
+            accepted += int(lead_h[slot])
+            # cursor advances over EXACTLY the emitted tokens' inputs:
+            # accepted-but-truncated tokens (budget/EOS) advance
+            # nothing and are counted nowhere — the next-input token
+            # and key chain were already picked to match on device
+            self.pool.advance(slot, n_emit)
+            for tk in tmat_h[slot, :n_emit]:
+                self._emit(req, int(tk))
+                emitted += 1
+        self.metrics.bump(sm.DECODE_TICKS)
+        self.metrics.bump(sm.SPEC_VERIFY_TICKS)
+        self.metrics.bump(sm.SPEC_PROPOSED, int(plen.sum()))
+        if accepted:
+            self.metrics.bump(sm.SPEC_ACCEPTED, accepted)
         return emitted
 
     def _emit(self, req: Request, tok: int) -> None:
@@ -1514,16 +1890,31 @@ class ServingEngine:
 
     # --------------------------------------------------------- inspection
 
+    @property
+    def weights_fp(self) -> str:
+        """Hex fingerprint of this engine's weights (serving/prefix.py
+        ``weights_fingerprint`` — the same digest the prefix-store salt
+        commits to).  Carried on the STATS reply as the engine's
+        identity, so a ``ServeRouter`` can refuse a replica serving
+        different weights instead of splicing silently-wrong resumes
+        (docs/serving.md "Router tier").  Computed lazily and cached:
+        prefix-cache engines already paid for it at construction."""
+        if self._weights_fp is None:
+            self._weights_fp = weights_fingerprint(self.variables).hex()
+        return self._weights_fp
+
     def compile_counts(self) -> Dict[str, int]:
         """Trace counts of the step programs — steady-state serving must
-        keep ``decode`` at 1, ``prefill``/``chunk`` at the number of
-        distinct buckets touched, and the prefix copy/extract programs
-        at 1 each (asserted by tests and bench_serve.py)."""
+        keep ``decode`` at 1, ``prefill``/``chunk``/``verify`` at the
+        number of distinct buckets touched, and the prefix copy/extract
+        programs at 1 each (asserted by tests and bench_serve.py)."""
         return {"decode": self.decode_traces,
                 "prefill": self.prefill_traces,
                 "prefill_buckets": len(self._prefill_fns),
                 "chunk": self.chunk_traces,
                 "chunk_buckets": len(self._chunk_fns),
+                "verify": self.verify_traces,
+                "verify_buckets": len(self._verify_fns),
                 "prefix_copy": self.prefix_copy_traces,
                 "prefix_extract": self.prefix_extract_traces,
                 "block_cow": self.block_cow_traces}

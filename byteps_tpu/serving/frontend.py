@@ -196,6 +196,11 @@ class _ServeHandler(socketserver.BaseRequestHandler):
                     elif op == OP_STATS:
                         payload = json.dumps(
                             {**engine.metrics.summary(),
+                             # engine identity: the weights fingerprint
+                             # the router's registration handshake
+                             # compares before trusting this replica
+                             # with resumes (serving/router.py)
+                             "weights_fingerprint": engine.weights_fp,
                              "compile_counts": engine.compile_counts(),
                              "occupancy": engine.pool.occupancy(),
                              "queue_depth": engine.scheduler.depth,
@@ -558,6 +563,8 @@ def serve_from_env(env=None) -> int:
         prefix_bytes=cfg.serve_prefix_mb << 20,
         paged=cfg.serve_paged,
         block=cfg.serve_block,
-        kv_mb=cfg.serve_kv_mb)
+        kv_mb=cfg.serve_kv_mb,
+        spec_k=(cfg.serve_spec_k if cfg.serve_spec else 0),
+        spec_ngram=cfg.serve_spec_ngram)
     serve(engine, cfg.serve_port)
     return 0

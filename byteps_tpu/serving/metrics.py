@@ -50,6 +50,21 @@ KV_BLOCKS_USED = "serve.kv_blocks_used"
 KV_BLOCKS_SHARED = "serve.kv_blocks_shared"
 BLOCK_EVICTIONS = "serve.block_evictions"
 PREEMPTIONS = "serve.preemptions"
+# speculative decoding (serving/engine.py spec_k > 0, serving/spec.py):
+# DECODE_TICKS counts ticks that ran a decode/verify forward (the
+# denominator of tokens-per-tick — what speculation exists to raise);
+# SPEC_* account the proposal economy.  PROPOSED counts tokens handed
+# to the verifier, ACCEPTED the proposed tokens the model confirmed
+# (extra tokens beyond the one-per-tick floor, BEFORE budget/eos
+# truncation — the verifier's own yield), VERIFY_TICKS the ticks that
+# ran the widened verify program instead of plain decode.  TOKENS
+# stays emissions-only: accepted-but-never-emitted tokens (truncated
+# at the request's budget or at EOS) are counted nowhere, so
+# TPOT/tokens-per-tick cannot be skewed by work the client never saw.
+DECODE_TICKS = "serve.decode_ticks"
+SPEC_PROPOSED = "serve.spec_proposed_tokens"
+SPEC_ACCEPTED = "serve.spec_accepted_tokens"
+SPEC_VERIFY_TICKS = "serve.spec_verify_ticks"
 # per-tick value tracks (gauges, not monotonic)
 OCCUPANCY = "serve.batch_occupancy"
 QUEUE_DEPTH = "serve.queue_depth"

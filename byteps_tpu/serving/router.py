@@ -85,8 +85,9 @@ from .frontend import (OP_PING, OP_STATS, OP_STREAM, OP_SUBMIT,
                        RemoteServeClient, ServeConnectionError,
                        _split_resume)
 
-__all__ = ["ReplicaState", "ReplicaLostError", "ServeRouter",
-           "RouterFrontend", "serve_router", "router_from_env"]
+__all__ = ["ReplicaState", "ReplicaLostError", "WeightsMismatchError",
+           "ServeRouter", "RouterFrontend", "serve_router",
+           "router_from_env"]
 
 # ------------------------------------------------------------- metric names
 REQUESTS = "router.requests"
@@ -103,6 +104,10 @@ RETRIES = "router.retries"
 AFFINITY_HITS = "router.affinity_hits"
 AFFINITY_MISSES = "router.affinity_misses"
 DRAINS = "router.drains"
+# replicas refused placement because their STATS weights fingerprint
+# disagrees with the tier's (resume across different checkpoints would
+# be silently wrong — docs/serving.md "Router tier")
+WEIGHTS_REFUSED = "router.weights_refused"
 # labeled per-replica gauges
 REPLICA_STATE = "router.replica_state"      # 0 healthy 1 suspect 2 dead
 REPLICA_INFLIGHT = "router.replica_inflight"  # 3 draining/retired
@@ -132,9 +137,18 @@ class ReplicaLostError(RuntimeError):
         super().__init__(msg)
 
 
+class WeightsMismatchError(RuntimeError):
+    """A replica's STATS weights fingerprint disagrees with the tier's:
+    it serves a different checkpoint, so a mid-stream re-dispatch onto
+    it would splice a silently-wrong continuation.  Raised typed at
+    registration (``ServeRouter.start``); at ping/failback time the
+    replica is refused placement instead (it stays alive but never
+    receives traffic until its fingerprint matches again)."""
+
+
 class _Replica:
     __slots__ = ("idx", "addr", "inflight", "suspect", "dead",
-                 "draining", "retired")
+                 "draining", "retired", "refused", "verified")
 
     def __init__(self, idx: int, addr: str):
         self.idx = idx
@@ -144,12 +158,18 @@ class _Replica:
         self.dead = False
         self.draining = False
         self.retired = False
+        # weights handshake: ``verified`` = fingerprint checked against
+        # the tier's; ``refused`` = checked and DISAGREED (alive but
+        # unplaceable until a later check matches — e.g. the operator
+        # restarted it on the right checkpoint)
+        self.refused = False
+        self.verified = False
 
     @property
     def state(self) -> ReplicaState:
         if self.draining or self.retired:
             return ReplicaState.DRAINING
-        if self.dead:
+        if self.dead or self.refused:
             return ReplicaState.DEAD
         if self.suspect:
             return ReplicaState.SUSPECT
@@ -157,7 +177,8 @@ class _Replica:
 
     @property
     def placeable(self) -> bool:
-        return not (self.dead or self.draining or self.retired)
+        return not (self.dead or self.draining or self.retired
+                    or self.refused)
 
 
 class ServeRouter:
@@ -218,9 +239,23 @@ class ServeRouter:
         for r in self._replicas:
             self._gauge_state(r)
 
+        self._expected_fp: Optional[str] = None
+
     # ------------------------------------------------------------ lifecycle
 
     def start(self) -> "ServeRouter":
+        """Run the registration handshake, then the heartbeat detector.
+
+        Registration compares every reachable replica's STATS weights
+        fingerprint (the same digest the prefix-store salt commits to —
+        serving/prefix.py ``weights_fingerprint``): the first fingerprint
+        seen becomes the tier's, and a disagreeing replica raises the
+        typed :class:`WeightsMismatchError` — refusing to build a tier
+        whose failover re-dispatch would splice tokens from different
+        checkpoints.  Replicas unreachable right now are re-checked on
+        their first successful ping and at failback."""
+        for r in self._replicas:
+            self._verify_replica_weights(r, raising=True)
         self._detector.start()
         return self
 
@@ -242,10 +277,62 @@ class ServeRouter:
 
     # --------------------------------------------------------------- health
 
+    def _verify_replica_weights(self, r: _Replica, *,
+                                raising: bool) -> bool:
+        """Weights handshake against one replica: fetch its STATS
+        fingerprint and compare with the tier's (the first fingerprint
+        seen).  A mismatch marks the replica REFUSED — alive, heartbeat-
+        tracked, but never placed — and raises the typed
+        :class:`WeightsMismatchError` when ``raising`` (registration
+        path).  A later matching check (operator restarted it on the
+        right checkpoint) clears the refusal.  Replicas that do not
+        report a fingerprint (pre-handshake builds) are accepted — the
+        operator-guarantees-homogeneity contract they were deployed
+        under.  Returns True when the replica is verified placeable."""
+        try:
+            c = RemoteServeClient(r.addr, timeout=self.ping_timeout)
+            try:
+                fp = c.stats().get("weights_fingerprint")
+            finally:
+                c.close()
+        except (OSError, ValueError, RuntimeError):
+            return False  # unreachable: re-checked at ping/failback
+        with self._lock:
+            if fp is None:
+                r.verified = True
+                r.refused = False
+                return True
+            if self._expected_fp is None:
+                self._expected_fp = fp
+            if fp == self._expected_fp:
+                r.verified = True
+                r.refused = False
+                return True
+            first_refusal = not r.refused
+            r.refused = True
+            r.verified = True
+        if first_refusal:
+            self._bump(WEIGHTS_REFUSED)
+        self._gauge_state(r)
+        msg = (f"replica {r.idx} ({r.addr}) serves different weights "
+               f"(fingerprint {fp[:16]}... != tier "
+               f"{self._expected_fp[:16]}...): refusing placement — a "
+               f"mid-stream re-dispatch onto it would splice a "
+               f"silently-wrong continuation.  Restart it on the "
+               f"tier's checkpoint to re-admit it.")
+        if raising:
+            raise WeightsMismatchError(msg)
+        bps_log.warning("router: %s", msg)
+        return False
+
     def _ping_replica(self, idx: int) -> bool:
         """Serve-protocol liveness probe: one short-timeout OP_PING
         round trip on a fresh connection (never contends with data
-        legs).  Drives the detector's suspect/dead transitions."""
+        legs).  Drives the detector's suspect/dead transitions.  Also
+        the retry path of the weights handshake: an alive replica that
+        was unreachable at registration (or refused since) re-verifies
+        here, so fixing its checkpoint re-admits it within a ping
+        interval."""
         r = self._replicas[idx]
         ok = False
         try:
@@ -258,6 +345,8 @@ class ServeRouter:
             ok = False
         if ok:
             r.suspect = False
+            if not r.verified or r.refused:
+                self._verify_replica_weights(r, raising=False)
         elif not r.dead:
             r.suspect = True
         self._gauge_state(r)
@@ -266,6 +355,13 @@ class ServeRouter:
     def _on_replica_down(self, idx: int) -> None:
         r = self._replicas[idx]
         r.dead, r.suspect = True, False
+        # a dead replica's identity is stale the moment it dies: the
+        # operator may restart it on a different checkpoint, and a
+        # transiently-failing failback re-check must not leave a stale
+        # verified=True letting it back in unchecked — clear it so the
+        # failback/ping/dispatch paths all re-verify until a STATS
+        # fetch actually succeeds
+        r.verified = False
         self._degraded.mark_down(idx)
         self._gauge_state(r)
         bps_log.warning("router: replica %d (%s) DEAD", idx, r.addr)
@@ -276,7 +372,14 @@ class ServeRouter:
             return  # drained replicas never re-enter placement
         r.dead = r.suspect = False
         self._degraded.mark_up(idx)
+        # failback handshake: a replica that went away and came back may
+        # have restarted on a different checkpoint — it must prove its
+        # weights before placement resumes (a mismatch leaves it alive
+        # but refused; matching again later re-admits it)
+        self._verify_replica_weights(r, raising=False)
         self._gauge_state(r)
+        if r.refused:
+            return
         bps_log.warning("router: replica %d (%s) re-admitted (failback)",
                         idx, r.addr)
 
@@ -452,6 +555,21 @@ class ServeRouter:
                 time.sleep(delay)
                 continue
             stalls = 0
+            if not r.verified and not self._verify_replica_weights(
+                    r, raising=False):
+                # registration could not reach this replica and it is
+                # still unverified (or the check just refused it): an
+                # unverified replica must never see traffic — a wrong-
+                # checkpoint replica receiving a resume re-dispatch in
+                # the window before its first successful ping is the
+                # exact splice the handshake exists to prevent.  Not a
+                # failed attempt: like saturation, this round simply
+                # skips it (the deadline bounds the overall wait, and a
+                # transiently-unreachable stats endpoint is retried on
+                # the next round / ping).
+                self._release(r)
+                tried.add(r.idx)
+                continue
             leg: Optional[RemoteServeClient] = None
             try:
                 leg = RemoteServeClient(r.addr,
@@ -575,7 +693,7 @@ class ServeRouter:
                                   "credits": self.credits}
         for name in (REQUESTS, COMPLETED, FAILED, FAILOVERS,
                      REDISPATCHES, SHEDS, RETRIES, AFFINITY_HITS,
-                     AFFINITY_MISSES, DRAINS):
+                     AFFINITY_MISSES, DRAINS, WEIGHTS_REFUSED):
             m = self._registry.get(name)
             out[name] = m.value if m is not None else 0
         return out

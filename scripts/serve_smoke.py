@@ -24,10 +24,20 @@ block grants, zero-copy prefix sharing, prefix-store pressure
 eviction, and preempt/resume — every path must stay token-identical
 to the same sequential baselines (docs/serving.md "Paged KV cache").
 
+``--spec`` enables n-gram speculative decoding on the engine under
+test (proposer + batched multi-token verify, docs/serving.md
+"Speculative decoding"): outputs must stay token-identical to the
+sequential baselines — greedy and seeded — under threaded arrivals,
+with exactly one verify program per speculation-depth bucket.
+``--paged --spec`` additionally drives preempt/resume while
+speculation is active (the tight block pool preempts requests between
+verify ticks; the parked token/key chain must survive).
+
 Usage:
     python scripts/serve_smoke.py [--requests 12] [--seed 0]
     python scripts/serve_smoke.py --prefix-share
     python scripts/serve_smoke.py --paged [--prefix-share]
+    python scripts/serve_smoke.py --spec [--paged]
 
 Wired into CI as a ``slow``-marked pytest (tests/test_serve_smoke.py)
 so tier-1 stays fast.
@@ -49,7 +59,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 def run(requests: int = 12, seed: int = 0, n_slots: int = 4,
         temperature: float = 0.0, verbose: bool = True,
-        prefix_share: bool = False, paged: bool = False) -> dict:
+        prefix_share: bool = False, paged: bool = False,
+        spec: int = 0) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -95,6 +106,10 @@ def run(requests: int = 12, seed: int = 0, n_slots: int = 4,
         baselines.append(np.asarray(out["tokens"])[0])
 
     engine_kw = dict(sample_kw)
+    if spec:
+        # n-gram speculation: proposals ride the requests' own history;
+        # parity against the sequential baselines is the whole claim
+        engine_kw.update(spec_k=spec)
     if paged:
         # paged KV cache under a DELIBERATELY tight block pool (the
         # floor is max_blocks + 1 = 13 at max_seq 96 / block 8; 16
@@ -183,6 +198,8 @@ def run(requests: int = 12, seed: int = 0, n_slots: int = 4,
              "decode_traces": counts["decode"],
              "prefill_buckets": counts["prefill_buckets"],
              "chunk_buckets": counts["chunk_buckets"],
+             "verify_traces": counts["verify"],
+             "verify_buckets": counts["verify_buckets"],
              "prefix_copy_traces": counts["prefix_copy"],
              "prefix_extract_traces": counts["prefix_extract"],
              "temperature": temperature,
@@ -207,12 +224,19 @@ def main(argv=None) -> int:
                          "pool: lazy grants, zero-copy prefix shares, "
                          "and preempt/resume under threaded arrivals "
                          "must all keep bit-exact parity")
+    ap.add_argument("--spec", type=int, nargs="?", const=4, default=0,
+                    help="n-gram speculative decoding at this depth "
+                         "(default 4 when given bare): parity vs the "
+                         "sequential baselines with one verify program "
+                         "per depth bucket; combine with --paged to "
+                         "exercise preempt/resume mid-speculation")
     args = ap.parse_args(argv)
     ok = True
     for temp in (0.0, 0.8):
         stats = run(requests=args.requests, seed=args.seed,
                     n_slots=args.slots, temperature=temp,
-                    prefix_share=args.prefix_share, paged=args.paged)
+                    prefix_share=args.prefix_share, paged=args.paged,
+                    spec=args.spec)
         ok = ok and stats["mismatches"] == 0 and stats["decode_traces"] == 1
         if args.prefix_share:
             ok = ok and stats.get("serve.prefix_hits", 0) > 0
@@ -221,6 +245,11 @@ def main(argv=None) -> int:
             # even exist on a paged engine
             ok = (ok and stats["prefix_copy_traces"] == 0
                   and stats["prefix_extract_traces"] == 0)
+        if args.spec:
+            # compile discipline: exactly one verify program per
+            # speculation-depth bucket over the whole run — a retrace
+            # would mean per-tick recompilation in steady state
+            ok = ok and stats["verify_traces"] == stats["verify_buckets"]
     print("serve_smoke:", "OK" if ok else "FAILED")
     return 0 if ok else 1
 

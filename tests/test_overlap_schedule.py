@@ -103,18 +103,16 @@ def mesh():
     return Mesh(np.array(jax.devices()), ("dp",))
 
 
-@pytest.mark.xfail(
-    strict=False,
-    reason="XLA:CPU scheduler placement divergence (documented in "
-    "PARITY.md): this jax/XLA build's CPU latency-hiding scheduler "
-    "sinks the grad collectives to ~the end of the entry schedule "
-    "(2 compute ops after, threshold 3).  The jaxpr-level independence "
-    "proof (test_overlap.py) and the TPU AOT schedule proof "
-    "(scripts/prove_overlap_schedule.py, docs/overlap_proof.md) both "
-    "still hold; only the CPU backend's schedule shape regressed.")
 def test_sync_step_buckets_straddle_backward(mesh):
     """Bucketed DP step: the compiled schedule issues bucket collectives
-    with compute still behind them — per-bucket overlap with backward."""
+    with compute still behind them — per-bucket overlap with backward.
+
+    History: this carried ``xfail(strict=False)`` for an XLA:CPU
+    scheduler regression (collectives sunk to ~the end of the entry
+    schedule — PARITY.md) and silently xpassed once the build moved on.
+    The mark is dropped so a real schedule regression fails loudly
+    again; the delayed-grad variant below still genuinely xfails on
+    this build and keeps its mark."""
     tx = optax.sgd(0.1, momentum=0.9)
     step = make_data_parallel_step(_loss_fn, tx, mesh)
     state = jax.eval_shape(lambda p: create_train_state(p, step.tx), _PARAMS)

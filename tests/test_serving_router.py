@@ -64,6 +64,17 @@ def _router(addrs, **kw):
     return ServeRouter(addrs, **kw)
 
 
+def _handshake(router):
+    """Run the registration weights handshake (what ``start()`` does at
+    boot) without starting the heartbeat detector.  Scripted-proxy
+    tests MUST do this BEFORE arming their fault: the handshake's
+    STATS round trip is a proxied request like any other, and the
+    proxy pops one script entry per request — an armed ``cut_stream``
+    would be consumed by the handshake instead of the stream leg."""
+    for rep in router._replicas:
+        router._verify_replica_weights(rep, raising=True)
+
+
 @pytest.fixture(scope="module")
 def tiny():
     cfg = TransformerConfig(vocab_size=61, num_layers=2, num_heads=2,
@@ -157,9 +168,10 @@ def test_router_failover_mid_stream_greedy(tiny, prompts, greedy_base,
     token-identical to an uninterrupted run."""
     _, _, addrs = replica_pair
     proxy = FaultInjectingProxy(addrs[0], serve_stream_op=OP_STREAM)
-    proxy.script(("cut_stream", 3))
     reg = MetricsRegistry()
     router = _router([proxy.addr, addrs[1]], registry=reg)
+    _handshake(router)  # boot-time; then arm the fault
+    proxy.script(("cut_stream", 3))
     try:
         got = list(router.stream(prompts[0], M))
         assert got == list(greedy_base[0])
@@ -188,8 +200,9 @@ def test_router_failover_mid_stream_seeded(tiny, prompts):
             for e in engines]
     addrs = ["127.0.0.1:%d" % s.server_address[1] for s in srvs]
     proxy = FaultInjectingProxy(addrs[0], serve_stream_op=OP_STREAM)
-    proxy.script(("cut_stream", 2))
     router = _router([proxy.addr, addrs[1]])
+    _handshake(router)  # boot-time; then arm the fault
+    proxy.script(("cut_stream", 2))
     try:
         got = list(router.stream(p, M, seed=7))
         assert got == list(want)
@@ -211,8 +224,9 @@ def test_router_completes_when_cut_after_final_token(tiny, prompts,
     to generate)."""
     _, _, addrs = replica_pair
     proxy = FaultInjectingProxy(addrs[0], serve_stream_op=OP_STREAM)
-    proxy.script(("cut_stream", M))  # all M tokens relayed, end cut
     router = _router([proxy.addr, addrs[1]])
+    _handshake(router)  # boot-time; then arm the fault
+    proxy.script(("cut_stream", M))  # all M tokens relayed, end cut
     try:
         got = list(router.stream(prompts[0], M))
         assert got == list(greedy_base[0])
@@ -576,3 +590,111 @@ def test_resume_submit_refused_on_kv_quant(tiny, prompts):
                             metrics=ServeMetrics())
     with pytest.raises(ValueError, match="nothing"):
         engine2.submit(prompts[0], 2, resume_tokens=[1, 2])
+
+
+# ------------------------------------------------- weights-fingerprint tier
+
+
+def test_router_weights_handshake_accepts_homogeneous(tiny, prompts,
+                                                      replica_pair):
+    """Registration over replicas serving the SAME weights: every
+    reachable replica verifies against the tier fingerprint, the STATS
+    wire op carries it, and traffic flows."""
+    _, srvs, addrs = replica_pair
+    c = RemoteServeClient(addrs[0])
+    fp = c.stats()["weights_fingerprint"]
+    c.close()
+    assert isinstance(fp, str) and len(fp) == 32  # blake2b-16 hex
+    router = _router(addrs).start()
+    try:
+        assert router._expected_fp == fp
+        assert all(r.verified and not r.refused
+                   for r in router._replicas)
+        assert router.stats()[rt.WEIGHTS_REFUSED] == 0
+    finally:
+        router.close()
+
+
+def test_router_weights_handshake_refuses_mismatch_at_registration(
+        tiny, prompts, greedy_base, replica_pair):
+    """A replica serving DIFFERENT weights is refused typed at
+    registration — a mid-stream re-dispatch onto it would splice a
+    silently-wrong continuation — and stays unplaceable while the
+    matching replica keeps serving token-identical streams."""
+    _, model, variables = tiny
+    _, _, addrs = replica_pair
+    other = model.init(jax.random.PRNGKey(99),
+                       jnp.zeros((1, 8), jnp.int32))
+    bad_eng = ServingEngine(model, other, n_slots=2, max_seq=64,
+                            temperature=0.0, metrics=ServeMetrics())
+    bad_srv = serve(bad_eng, 0, host="127.0.0.1", in_thread=True)[0]
+    bad_addr = "127.0.0.1:%d" % bad_srv.server_address[1]
+    router = _router([addrs[0], bad_addr])
+    try:
+        with pytest.raises(rt.WeightsMismatchError, match="different"):
+            router.start()
+        bad = router._replicas[1]
+        assert bad.refused and not bad.placeable
+        assert bad.state is ReplicaState.DEAD
+        assert router.stats()[rt.WEIGHTS_REFUSED] == 1
+        # placement skips the refused replica: every request lands on
+        # the matching one, token-identical
+        for p, b in zip(prompts[:2], greedy_base[:2]):
+            np.testing.assert_array_equal(router.generate(p, M), b)
+        assert _submitted(bad_eng) == 0
+    finally:
+        router.close()
+        bad_srv.shutdown()
+        bad_srv.server_close()
+
+
+def test_router_weights_handshake_on_ping_and_failback(tiny, prompts,
+                                                       replica_pair):
+    """A replica unreachable at registration verifies on its first
+    successful ping (the failback probe path): a mismatch refuses it
+    without raising — background threads cannot propagate — and a
+    later matching fingerprint re-admits it."""
+    _, model, variables = tiny
+    _, _, addrs = replica_pair
+    other = model.init(jax.random.PRNGKey(98),
+                       jnp.zeros((1, 8), jnp.int32))
+    bad_eng = ServingEngine(model, other, n_slots=2, max_seq=64,
+                            temperature=0.0, metrics=ServeMetrics())
+    bad_srv = serve(bad_eng, 0, host="127.0.0.1", in_thread=True)[0]
+    bad_addr = "127.0.0.1:%d" % bad_srv.server_address[1]
+    # registration sees only the good replica (the bad one's port is
+    # swapped in afterwards, as if it had been down)
+    router = _router([addrs[0], "127.0.0.1:1"])
+    router._verify_replica_weights(router._replicas[0], raising=False)
+    assert router._expected_fp is not None
+    # a verified replica that DIES loses its verification: the restart
+    # may carry a different checkpoint, and a transiently-failing
+    # failback re-check must not readmit it on the stale flag
+    assert router._replicas[0].verified
+    router._on_replica_down(0)
+    assert not router._replicas[0].verified
+    router._on_replica_up(0)  # failback re-verifies against the addr
+    assert router._replicas[0].verified and router._replicas[0].placeable
+    router._replicas[1].addr = bad_addr
+    # the detector's probe path: ping ok -> verify -> refused, typed
+    # error swallowed into the refusal state + counter
+    assert router._ping_replica(1)
+    assert router._replicas[1].refused
+    assert router.stats()[rt.WEIGHTS_REFUSED] == 1
+    # operator fixes the checkpoint (same weights now): next probe
+    # re-admits without restart ceremony
+    good_eng2 = ServingEngine(model, variables, n_slots=2, max_seq=64,
+                              temperature=0.0, metrics=ServeMetrics())
+    good_srv2 = serve(good_eng2, 0, host="127.0.0.1", in_thread=True)[0]
+    try:
+        router._replicas[1].addr = \
+            "127.0.0.1:%d" % good_srv2.server_address[1]
+        assert router._ping_replica(1)
+        assert not router._replicas[1].refused
+        assert router._replicas[1].placeable
+    finally:
+        router.close()
+        bad_srv.shutdown()
+        bad_srv.server_close()
+        good_srv2.shutdown()
+        good_srv2.server_close()

@@ -1,0 +1,283 @@
+"""Speculative decoding (serving/spec.py + the engine's verify path).
+
+THE parity anchor, extended to multi-token ticks: a speculating engine
+— n-gram prompt-lookup proposals, one batched ``verify_tokens`` pass
+per tick, longest-matching-prefix acceptance — must emit streams
+token-identical to sequential ``generate()`` (and so to the
+non-speculative engine), greedy AND seeded, dense AND paged, across
+budget/EOS truncation, router-style resume, and a preempt/resume cycle
+fired between verify ticks.  Acceptance-only-on-match makes wrong
+proposals harmless by construction; these tests pin it bit-for-bit.
+
+Compile discipline rides along: exactly one verify program per
+speculation-depth bucket (the chunk-bucket rule), and the metric
+contract — accepted-but-never-emitted tokens count nowhere, so
+TPOT/`serve.tokens` cannot be skewed by work no client saw.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from byteps_tpu.inference import generate
+from byteps_tpu.models.transformer import Transformer, TransformerConfig
+from byteps_tpu.serving import NgramProposer, ServeMetrics, ServingEngine
+from byteps_tpu.serving import metrics as sm
+
+M = 8  # tokens per request, shared so generate() compiles once per mode
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = TransformerConfig(vocab_size=61, num_layers=2, num_heads=2,
+                            d_model=32, d_ff=64, max_seq_len=64,
+                            dtype=jnp.float32)
+    model = Transformer(cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(0), (1, 8), 0, 61)
+    variables = model.init(jax.random.PRNGKey(1), toks)
+    return cfg, model, variables
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    # one highly repetitive prompt (the proposer's sweet spot) and one
+    # random prompt (proposals must be harmless when wrong)
+    rep = np.asarray((list(range(5)) * 4)[:18], np.int32)
+    rnd = np.asarray(jax.random.randint(
+        jax.random.PRNGKey(10), (7,), 0, 61), np.int32)
+    return [rep, rnd]
+
+
+@pytest.fixture(scope="module")
+def greedy_base(tiny, prompts):
+    _, model, variables = tiny
+    return [np.asarray(generate(model, variables, p[None], M,
+                                temperature=0.0)["tokens"])[0]
+            for p in prompts]
+
+
+# ---------------------------------------------------------------- proposer
+
+
+def test_proposer_prompt_lookup_semantics():
+    p = NgramProposer(4, ngram=3, min_ngram=1)
+    # trailing [1, 2] last occurred at index 1; continuation follows it
+    ctx = np.asarray([0, 1, 2, 3, 4, 1, 2], np.int32)
+    assert p.propose(ctx, 4) == [3, 4, 1, 2]
+    assert p.propose(ctx, 2) == [3, 4]  # cap bounds the proposal
+    # a full-depth continuation is preferred over a more recent but
+    # shorter one (short-period repetition would otherwise cap
+    # proposals at the period length)...
+    ctx = np.asarray([1, 2, 9, 1, 2, 8, 1, 2], np.int32)
+    assert p.propose(ctx, 4) == [9, 1, 2, 8]
+    # ...and when no occurrence has full depth, the most recent wins
+    ctx2 = np.asarray([5, 9, 1, 2, 8, 1, 2], np.int32)
+    assert p.propose(ctx2, 4) == [8, 1, 2]
+    # pure-period output proposes full depth, not one period
+    sevens = np.full(10, 7, np.int32)
+    assert p.propose(sevens, 4) == [7, 7, 7, 7]
+    # longest n-gram first: the 3-gram match beats the 1-gram one
+    ctx = np.asarray([5, 6, 7, 1, 0, 7, 5, 6, 7], np.int32)
+    assert p.propose(ctx, 2) == [1, 0]
+    # cap bounds the proposal length
+    assert p.propose(np.asarray([3, 4, 3], np.int32), 1) == [4]
+    # nothing to match -> no proposal, and degenerate contexts are safe
+    assert p.propose(np.asarray([1, 2, 3], np.int32), 4) == []
+    assert p.propose(np.asarray([7], np.int32), 4) == []
+    assert p.propose(np.asarray([1, 1], np.int32), 0) == []
+
+
+def test_proposer_min_ngram_floor_stands_down():
+    """A single repeated token is noise on non-repetitive output: the
+    default floor of 2 refuses to propose from it (every false proposal
+    costs a widened verify forward)."""
+    ctx = np.asarray([1, 2, 3, 4, 5, 6, 3], np.int32)
+    assert NgramProposer(4, ngram=3).propose(ctx, 4) == []
+    assert NgramProposer(4, ngram=3,
+                         min_ngram=1).propose(ctx, 4) == [4, 5, 6, 3]
+
+
+def test_proposer_validation():
+    with pytest.raises(ValueError):
+        NgramProposer(0)
+    with pytest.raises(ValueError):
+        NgramProposer(4, ngram=0)
+
+
+# ------------------------------------------------------------------ parity
+
+
+def test_spec_greedy_parity_and_compile_counts(tiny, prompts, greedy_base):
+    """Speculating engine output is bit-identical to generate() for a
+    repetitive AND a random prompt batched together, with exactly one
+    verify program per depth bucket and the decode program untouched."""
+    _, model, variables = tiny
+    eng = ServingEngine(model, variables, n_slots=2, max_seq=64,
+                        temperature=0.0, spec_k=4,
+                        metrics=ServeMetrics())
+    reqs = [eng.submit(p, M) for p in prompts]
+    eng.drain(timeout=120)
+    for r, b in zip(reqs, greedy_base):
+        np.testing.assert_array_equal(r.result(), b)
+    counts = eng.compile_counts()
+    assert counts["decode"] == 1
+    assert counts["verify"] == counts["verify_buckets"]
+    # depth buckets stay on the {1, 2, 4} grid (spec_k rounds to 2^n)
+    assert set(eng._verify_fns) <= {2, 3, 5}
+    # a second round with warm programs must not retrace anything
+    reqs = [eng.submit(p, M) for p in prompts]
+    eng.drain(timeout=120)
+    for r, b in zip(reqs, greedy_base):
+        np.testing.assert_array_equal(r.result(), b)
+    assert eng.compile_counts() == counts
+
+
+def test_spec_seeded_parity(tiny, prompts):
+    """Seeded sampling under speculation replays generate()'s exact
+    per-step key chain: accepted positions consume exactly one split
+    each, rejected positions' splits are discarded with them."""
+    _, model, variables = tiny
+    base = [np.asarray(generate(
+        model, variables, p[None], M, temperature=0.8, top_k=20,
+        rng=jax.random.PRNGKey(100 + i))["tokens"])[0]
+        for i, p in enumerate(prompts)]
+    eng = ServingEngine(model, variables, n_slots=2, max_seq=64,
+                        temperature=0.8, top_k=20, spec_k=4,
+                        metrics=ServeMetrics())
+    reqs = [eng.submit(p, M, seed=100 + i)
+            for i, p in enumerate(prompts)]
+    eng.drain(timeout=120)
+    for r, b in zip(reqs, base):
+        np.testing.assert_array_equal(r.result(), b)
+
+
+def test_spec_paged_parity_with_preempt_mid_speculation(tiny):
+    """Paged + speculation + block pressure: a request preempted while
+    speculation is active resumes by re-prefill and continues the
+    parked token/key chain — both streams bit-identical to generate(),
+    greedy and seeded (the ISSUE's preempt-mid-speculation anchor)."""
+    _, model, variables = tiny
+    pA = np.asarray((list(range(6)) * 4)[:19], np.int32)
+    pB = np.asarray((list(range(7, 12)) * 4)[:18], np.int32)
+    m = 30  # each needs ~7 of the pool's 8 usable blocks
+    for temp, kw in ((0.0, {}), (0.8, {"top_k": 20})):
+        base = []
+        for i, p in enumerate((pA, pB)):
+            g = dict(kw)
+            if temp:
+                g["rng"] = jax.random.PRNGKey(40 + i)
+            base.append(np.asarray(generate(
+                model, variables, p[None], m, temperature=temp,
+                **g)["tokens"])[0])
+        eng = ServingEngine(model, variables, n_slots=2, max_seq=64,
+                            temperature=temp, paged=True, block=8,
+                            kv_blocks=9, spec_k=4,
+                            metrics=ServeMetrics(), **kw)
+        r0 = eng.submit(pA, m, seed=40)
+        r1 = eng.submit(pB, m, seed=41)
+        eng.drain(timeout=120)
+        np.testing.assert_array_equal(r0.result(), base[0])
+        np.testing.assert_array_equal(r1.result(), base[1])
+        assert eng.metrics.get(sm.PREEMPTIONS) >= 1
+        assert eng.pool.alloc.used_count == 1  # all blocks reclaimed
+
+
+def test_spec_resume_tokens_feed_proposer(tiny, prompts):
+    """Router-style resume on a speculating engine: the resumed history
+    seeds the proposer's context and the continued stream is
+    token-identical to the never-interrupted run — greedy and seeded."""
+    _, model, variables = tiny
+    p = prompts[0]  # repetitive: the resumed tokens must drive matches
+    cut = 3
+    for temp, kw, seed in ((0.0, {}, 0), (0.8, {"top_k": 20}, 77)):
+        g = dict(kw)
+        if temp:
+            g["rng"] = jax.random.PRNGKey(seed)
+        full = np.asarray(generate(model, variables, p[None], M,
+                                   temperature=temp, **g)["tokens"])[0]
+        eng = ServingEngine(model, variables, n_slots=1, max_seq=64,
+                            temperature=temp, spec_k=4,
+                            metrics=ServeMetrics(), **kw)
+        req = eng.submit(p, M, seed=seed,
+                         resume_tokens=[int(t) for t in full[:cut]])
+        eng.drain(timeout=120)
+        np.testing.assert_array_equal(req.result(), full)
+
+
+def test_spec_eos_truncates_accepted_span(tiny, prompts, greedy_base):
+    """An EOS inside an accepted span ends the request AT the EOS:
+    later accepted tokens are never emitted (greedy trajectories are
+    prefix-stable, so the expectation is the no-EOS baseline cut at
+    the first EOS)."""
+    _, model, variables = tiny
+    full = greedy_base[0]
+    eos = int(full[4])
+    want = list(full[:list(full).index(eos) + 1])
+    eng = ServingEngine(model, variables, n_slots=1, max_seq=64,
+                        temperature=0.0, eos_id=eos, spec_k=4,
+                        metrics=ServeMetrics())
+    req = eng.submit(prompts[0], M)
+    eng.drain(timeout=120)
+    np.testing.assert_array_equal(req.result(), want)
+    assert eng.metrics.get(sm.TOKENS) == len(want)
+
+
+# ----------------------------------------------------------------- metrics
+
+
+def test_spec_metrics_count_only_emitted_tokens(tiny, prompts):
+    """Metric accuracy under speculation: `serve.tokens` and the
+    per-request completion count reflect EMITTED tokens only — an
+    accepted span truncated by the budget contributes nothing beyond
+    it (the mirror of the PR 10 resumed-token exclusion), and
+    tokens-per-tick accounting (DECODE_TICKS) includes verify ticks."""
+    _, model, variables = tiny
+    budget = 3  # small budget: accepted spans will overrun it
+    eng = ServingEngine(model, variables, n_slots=2, max_seq=64,
+                        temperature=0.0, spec_k=4,
+                        metrics=ServeMetrics())
+    reqs = [eng.submit(p, budget) for p in prompts]
+    eng.drain(timeout=120)
+    for r in reqs:
+        assert len(r.result()) == budget
+    snap = eng.metrics.snapshot()
+    assert snap[sm.TOKENS] == budget * len(prompts)
+    assert snap[sm.DECODE_TICKS] >= 1
+    # and a resumed request still counts only THIS engine's emissions
+    full = np.asarray(generate(model, variables, prompts[0][None], M,
+                               temperature=0.0)["tokens"])[0]
+    eng2 = ServingEngine(model, variables, n_slots=1, max_seq=64,
+                         temperature=0.0, spec_k=4,
+                         metrics=ServeMetrics())
+    req = eng2.submit(prompts[0], M,
+                      resume_tokens=[int(t) for t in full[:3]])
+    eng2.drain(timeout=120)
+    np.testing.assert_array_equal(req.result(), full)
+    assert eng2.metrics.get(sm.TOKENS) == M - 3
+
+
+# ------------------------------------------------------------------ guards
+
+
+def test_spec_guards_and_depth_rounding(tiny):
+    _, model, variables = tiny
+    # kv_quant has no speculative path (accumulation-order divergence)
+    with pytest.raises(ValueError, match="dense fp"):
+        ServingEngine(model, variables, n_slots=1, max_seq=64,
+                      kv_quant=True, spec_k=4, metrics=ServeMetrics())
+    # only the grouped cache layout decodes and verifies through the
+    # same (dense) attention path
+    with pytest.raises(ValueError, match="grouped"):
+        ServingEngine(model, variables, n_slots=1, max_seq=64,
+                      cache_layout="auto", spec_k=4,
+                      metrics=ServeMetrics())
+    # depth rounds down to the power-of-two bucket grid, and the ngram
+    # floor of 2 survives an operator asking for 1 (single-token
+    # matches are noise — the documented env.md contract)
+    eng = ServingEngine(model, variables, n_slots=1, max_seq=64,
+                        spec_k=7, spec_ngram=1, metrics=ServeMetrics())
+    assert eng.spec.k == 4
+    assert eng.spec.min_ngram == 2
+    assert ServingEngine(model, variables, n_slots=1, max_seq=64,
+                         metrics=ServeMetrics()).spec is None
