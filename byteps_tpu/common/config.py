@@ -162,6 +162,11 @@ class Config:
     serve_paged: bool = False     # paged KV cache (block-granular pool)
     serve_block: int = 16         # KV block size in tokens (paged)
     serve_kv_mb: int = 0          # paged KV pool budget (MiB); 0 = dense-equiv
+    # fused paged-attention decode kernel (ops/paged_attention.py):
+    # block-table-indexed KV reads, no gather copy.  auto = on for
+    # paged engines on TPU, off elsewhere (the CPU fallback keeps the
+    # pos-capped XLA gather); on forces it (interpret mode off-TPU)
+    serve_paged_kernel: str = "auto"
     # speculative decoding (serving/spec.py + engine verify path):
     # n-gram prompt-lookup proposals verified in one batched pass per
     # tick — multiplies tokens/tick on repetitive output while staying
@@ -192,6 +197,11 @@ class Config:
     router_stream_timeout_ms: float = 30_000.0
     router_heartbeat_ms: float = 500.0   # replica health-check cadence
     router_miss_threshold: int = 3       # consecutive misses => DEAD
+    # operator-pinned expected weights fingerprint (hex, the engine's
+    # STATS weights_fingerprint): "" = first-verified-replica-wins
+    # anchoring; set it and the tier refuses ANY replica that does not
+    # prove this exact checkpoint (docs/serving.md "Weights handshake")
+    router_weights_fp: str = ""
 
     # --- pipelined wire engine (byteps_tpu/engine/wire.py; the client
     # half of the push/pull pipelining BytePS keeps the wire busy with —
@@ -303,6 +313,8 @@ class Config:
             serve_paged=_env_bool("BYTEPS_SERVE_PAGED"),
             serve_block=_env_int("BYTEPS_SERVE_BLOCK", 16),
             serve_kv_mb=_env_int("BYTEPS_SERVE_KV_MB", 0),
+            serve_paged_kernel=_env_str("BYTEPS_SERVE_PAGED_KERNEL",
+                                        "auto"),
             serve_spec=_env_bool("BYTEPS_SERVE_SPEC"),
             serve_spec_k=_env_int("BYTEPS_SERVE_SPEC_K", 4),
             serve_spec_ngram=_env_int("BYTEPS_SERVE_SPEC_NGRAM", 3),
@@ -322,6 +334,7 @@ class Config:
                 "BYTEPS_ROUTER_HEARTBEAT_MS", 500.0),
             router_miss_threshold=_env_int(
                 "BYTEPS_ROUTER_MISS_THRESHOLD", 3),
+            router_weights_fp=_env_str("BYTEPS_ROUTER_WEIGHTS_FP", ""),
             wire_window=_env_int("BYTEPS_WIRE_WINDOW", 8),
             wire_fanout=_env_int("BYTEPS_WIRE_FANOUT", 16),
             transport=_env_str("BYTEPS_TRANSPORT", "auto"),

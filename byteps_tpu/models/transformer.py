@@ -304,7 +304,9 @@ def apply_rope(x, positions, theta: float = 10000.0, scaling=None):
     """Rotary position embedding, HF half-split convention:
     ``x [B, T, H, D]`` rotated by per-position angles
     ``pos / theta^(2i/D)``; ``positions`` is ``[T]`` absolute offsets
-    (prefill: ``arange(T)``; decode step: ``pos + arange(tq)``).
+    (prefill: ``arange(T)``; decode step: ``pos + arange(tq)``) or
+    ``[B, T]`` when each batch row sits at its own offset (the fused
+    paged decode step — every serving slot has its own cursor).
 
     The rotation acts on (x[..., :D/2], x[..., D/2:]) pairs — the same
     ``rotate_half`` layout HF LLaMA uses, so converted q/k weights work
@@ -319,9 +321,11 @@ def apply_rope(x, positions, theta: float = 10000.0, scaling=None):
                                 / half))
     if scaling is not None:
         inv_freq = _scaled_inv_freq(inv_freq, scaling)
-    ang = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
-    cos = jnp.cos(ang)[None, :, None, :]   # [1, T, 1, D/2]
-    sin = jnp.sin(ang)[None, :, None, :]
+    ang = positions.astype(jnp.float32)[..., None] * inv_freq
+    if ang.ndim == 2:                      # [T, D/2] -> broadcast batch
+        ang = ang[None]
+    cos = jnp.cos(ang)[:, :, None, :]      # [1|B, T, 1, D/2]
+    sin = jnp.sin(ang)[:, :, None, :]
     xf = x.astype(jnp.float32)
     x1, x2 = xf[..., :half], xf[..., half:]
     return jnp.concatenate(
@@ -468,8 +472,13 @@ class Attention(nn.Module):
             # is stored rotated — RoPE's relative-position property
             # makes scores depend only on position deltas, so rotating
             # at write time is exact)
-            rpos = (pos + jnp.arange(x.shape[1]) if cache is not None
-                    else jnp.arange(x.shape[1]))
+            if cache is None:
+                rpos = jnp.arange(x.shape[1])
+            elif jnp.ndim(pos) == 1:
+                # fused paged decode: per-slot cursors [B]
+                rpos = pos[:, None] + jnp.arange(x.shape[1])[None, :]
+            else:
+                rpos = pos + jnp.arange(x.shape[1])
             q = apply_rope(q, rpos, cfg.rope_theta, cfg.rope_scaling)
             k = apply_rope(k, rpos, cfg.rope_theta, cfg.rope_scaling)
         o_proj = QuantDense(
@@ -490,6 +499,29 @@ class Attention(nn.Module):
                     "KV-cache decode does not support key_mask: pad "
                     "tokens' K/V would enter the cache as real context. "
                     "Strip padding from the prompt before generate().")
+            if "table" in cache:
+                # fused paged decode/verify (serving paged_kernel path,
+                # Transformer.decode_paged_fused): fresh K/V scatters
+                # into the SHARED block pool at host-computed (block,
+                # offset) targets, then the Pallas kernel reads
+                # allocated, position-covered blocks in place through
+                # the block table — no gathered dense row, no extra
+                # copy of the cache stream (ops/paged_attention.py).
+                # Masked/ungranted positions aim at the null block,
+                # whose content is never admitted by the causal mask.
+                B_, T_ = x.shape[0], x.shape[1]
+                pk, pv = cache["k"], cache["v"]
+                wblk, woff = cache["wblk"], cache["woff"]
+                row_k = k.reshape(B_, T_, KV * D).astype(pk.dtype)
+                row_v = v.reshape(B_, T_, KV * D).astype(pv.dtype)
+                pk = pk.at[wblk, woff].set(row_k)
+                pv = pv.at[wblk, woff].set(row_v)
+                from ..ops.paged_attention import paged_decode_attention
+
+                out = paged_decode_attention(q, pk, pv, cache["table"],
+                                             pos,
+                                             window=cfg.attn_window)
+                return o_proj(out), dict(cache, k=pk, v=pv)
             import math as _math
 
             quant_cache = cache["k"].dtype == jnp.int8
@@ -807,7 +839,11 @@ class Transformer(nn.Module):
         """
         x = self.embed(tokens)
         if self.cfg.pos_emb == "learned":
-            x = x + self.pos((pos + jnp.arange(tokens.shape[1]))[None, :])
+            idx = (pos[:, None] + jnp.arange(tokens.shape[1])[None, :]
+                   if jnp.ndim(pos) == 1     # per-slot cursors (fused
+                   else (pos                 # paged decode)
+                         + jnp.arange(tokens.shape[1]))[None, :])
+            x = x + self.pos(idx)
         new_caches = []
         for block, c in zip(self.blocks, caches):
             x, nc = block(x, cache=c, pos=pos)
@@ -849,7 +885,7 @@ class Transformer(nn.Module):
         return self.decode(tokens, caches, pos, last_idx=last_idx)
 
     def decode_paged(self, tokens, pcaches, table, pos, last_only=False,
-                     last_idx=None):
+                     last_idx=None, hw_blocks=None):
         """`decode` against a **paged** KV cache: one slot's contiguous
         cache rows are gathered from the per-layer block pools
         (``pcaches``: ``[n_blocks, block, ...]`` per layer) via the
@@ -864,8 +900,16 @@ class Transformer(nn.Module):
         with this step's K/V written at ``[pos, pos + tq)``; the caller
         (the serving engine's jitted decode step) slices the written
         span back out and scatters it into the block pool.
+
+        ``hw_blocks`` (static int) caps the gather at the slot's block
+        high-water mark: only ``table[:hw_blocks]`` is gathered and the
+        attention row is ``hw_blocks * block`` wide instead of
+        ``max_seq`` — the XLA fallback stops streaming null-block /
+        unwritten padding every tick.  Bit-exact for any ``hw_blocks``
+        covering ``pos + tq``: the dropped tail is exactly the masked
+        region whose scores contribute zero probability mass.
         """
-        rows = gather_paged_rows(pcaches, table)
+        rows = gather_paged_rows(pcaches, table, hw_blocks=hw_blocks)
         return self.decode(tokens, rows, pos, last_only=last_only,
                            last_idx=last_idx)
 
@@ -876,6 +920,37 @@ class Transformer(nn.Module):
         :meth:`decode_paged`)."""
         rows = gather_paged_rows(pcaches, table)
         return self.prefill_chunk(tokens, rows, pos, last_idx)
+
+    def decode_paged_fused(self, tokens, pcaches, tables, pos, wblk,
+                           woff, last_only=False):
+        """``decode`` against a paged cache WITHOUT the gather: every
+        layer's attention writes the fresh K/V straight into the block
+        pool at the host-computed ``(wblk, woff) [N, tq]`` targets and
+        reads allocated, position-covered blocks in place through the
+        per-slot block table (``tables [N, max_blocks]``) — the fused
+        Pallas kernel path (ops/paged_attention.py).  ``pos [N]`` is a
+        per-slot cursor vector: unlike :meth:`decode_paged` this method
+        is NOT vmapped per slot — one kernel call serves the whole pool
+        (the kernel's grid is (N, max_blocks)).
+
+        Returns ``(logits [N, tq, vocab], new_pcaches)`` — the pool
+        comes back updated; there is nothing to scatter."""
+        views = tuple(dict(c, table=tables, wblk=wblk, woff=woff)
+                      for c in pcaches)
+        logits, new = self.decode(tokens, views, pos,
+                                  last_only=last_only)
+        return logits, tuple({"k": c["k"], "v": c["v"]} for c in new)
+
+    def verify_tokens_paged_fused(self, tokens, pcaches, tables, pos,
+                                  wblk, woff):
+        """:meth:`decode_paged_fused` at ``k + 1`` query positions —
+        the speculative verify on the fused kernel path.  Plain decode
+        and verify ride the SAME kernel, whose per-row online-softmax
+        accumulation is identical at every query width, so spec-on
+        stays token-identical to spec-off (the one-implementation
+        argument of :meth:`verify_tokens`, one indirection deeper)."""
+        return self.decode_paged_fused(tokens, pcaches, tables, pos,
+                                       wblk, woff)
 
     def verify_tokens(self, tokens, caches, pos):
         """Speculative-decoding verify: the decode step generalized from
@@ -899,17 +974,19 @@ class Transformer(nn.Module):
         "Speculative decoding")."""
         return self.decode(tokens, caches, pos)
 
-    def verify_tokens_paged(self, tokens, pcaches, table, pos):
+    def verify_tokens_paged(self, tokens, pcaches, table, pos,
+                            hw_blocks=None):
         """:meth:`verify_tokens` over a paged cache: gather the slot's
         rows through its block table, verify the ``k + 1`` positions in
         one pass, return ``(logits [B, k+1, vocab], written rows)`` for
         the caller's per-position scatter-back (see
-        :meth:`decode_paged`)."""
-        rows = gather_paged_rows(pcaches, table)
+        :meth:`decode_paged`; ``hw_blocks`` caps the gather at the
+        high-water block, which must cover ``pos + k + 1``)."""
+        rows = gather_paged_rows(pcaches, table, hw_blocks=hw_blocks)
         return self.decode(tokens, rows, pos)
 
 
-def gather_paged_rows(pcaches, table):
+def gather_paged_rows(pcaches, table, hw_blocks=None):
     """Assemble one slot's contiguous cache view from paged per-layer
     block pools: ``c [n_blocks, block, ...]`` indexed by the slot's
     block table ``[max_blocks]`` -> ``[1, max_blocks * block, ...]``.
@@ -920,12 +997,22 @@ def gather_paged_rows(pcaches, table):
     admits only positions below the cursor, and masked scores
     contribute exactly-zero probability mass (serving/slots.py).  The
     serving engine enforces ``max_blocks * block == max_seq`` so the
-    gathered row is shape-identical to a dense cache row."""
+    gathered row is shape-identical to a dense cache row.
+
+    ``hw_blocks`` (static int) gathers only ``table[:hw_blocks]`` — the
+    per-tick block high-water mark.  Every gathered byte past the
+    highest written position is pure waste (null-block padding or
+    masked stale content), so the serving engine caps the gather at a
+    bucketed high-water instead of streaming the full table width each
+    tick; the shorter row stays value-identical over the admitted
+    (masked-in) region."""
+    if hw_blocks is not None:
+        table = table[..., :hw_blocks]
     out = []
     for layer in pcaches:
         row = {}
         for name, c in layer.items():
-            g = c[table]  # [max_blocks, block, ...]
+            g = c[table]  # [hw_blocks, block, ...]
             row[name] = g.reshape(
                 (1, g.shape[0] * g.shape[1]) + g.shape[2:])
         out.append(row)

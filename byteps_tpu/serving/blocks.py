@@ -212,16 +212,26 @@ class BlockTable:
         self.blocks.clear()
 
 
-def init_paged_cache(cfg: TransformerConfig, n_blocks: int, block: int):
-    """Zeroed paged KV cache: per layer ``{"k","v"}`` of shape
-    ``[n_blocks, block, kv_heads, d_head]``.  The grouped (dense
-    mixed-dot) layout only — the paged engine's gathered rows feed the
-    same ``_cached_attention`` the contiguous grouped cache feeds; the
-    flat Pallas layout has no head axis to page and the int8 cache
-    reads quantized values at traced positions (both refused upstream,
-    ``ServingEngine``)."""
+def init_paged_cache(cfg: TransformerConfig, n_blocks: int, block: int,
+                     layout: str = "grouped"):
+    """Zeroed paged KV cache: per layer ``{"k","v"}``.
+
+    * ``"grouped"`` — ``[n_blocks, block, kv_heads, d_head]``: the
+      gather path's layout; gathered rows feed the same
+      ``_cached_attention`` the contiguous grouped cache feeds.
+    * ``"flat"`` — ``[n_blocks, block, kv_heads * d_head]``: the fused
+      paged-attention kernel's layout (ops/paged_attention.py) — one
+      block is one fully contiguous chunk the kernel DMAs per grid
+      step.  Reshaping a grouped pool at call time would physically
+      re-tile the whole pool every tick (the decode-kernel layout
+      lesson, ops/decode_attention.py), so the layout lives in the
+      pool itself.
+
+    The int8 cache reads quantized values at traced positions (refused
+    upstream, ``ServingEngine``)."""
     KV, D = cfg.kv_heads, cfg.d_head
-    shape = (n_blocks, block, KV, D)
+    shape = ((n_blocks, block, KV * D) if layout == "flat"
+             else (n_blocks, block, KV, D))
     return tuple(
         {"k": jnp.zeros(shape, cfg.dtype),
          "v": jnp.zeros(shape, cfg.dtype)}
@@ -256,11 +266,11 @@ class PagedSlotPool(SlotPool):
                 " gathered rows are attended at traced positions, which"
                 " under int8 reads already-quantized K/V and breaks the"
                 " bit-exact parity contract")
-        if layout not in ("grouped", "auto"):
+        if layout not in ("grouped", "auto", "flat"):
             raise ValueError(
-                f'paged KV cache supports layout="grouped" only (the '
-                f'flat stream has no block structure to page), got '
-                f'{layout!r}')
+                f'paged KV cache supports layout="grouped" (gather '
+                f'path) or "flat" (fused paged-attention kernel, '
+                f'ops/paged_attention.py), got {layout!r}')
         if block < 1:
             raise ValueError(f"block must be >= 1, got {block}")
         if max_seq % block:
@@ -293,7 +303,8 @@ class PagedSlotPool(SlotPool):
                 f"lower max_seq")
         self._n_blocks = n_blocks
         super().__init__(cfg, n_slots, max_seq, kv_quant=False,
-                         layout="grouped")
+                         layout=("flat" if layout == "flat"
+                                 else "grouped"))
         self.alloc = BlockAllocator(n_blocks, block)
         # physical block 0, allocated once and held forever: gather
         # source for unallocated table entries and scatter sink for
@@ -305,7 +316,8 @@ class PagedSlotPool(SlotPool):
         self._tables_dev = None
 
     def _init_caches(self):
-        return init_paged_cache(self.cfg, self._n_blocks, self.block)
+        return init_paged_cache(self.cfg, self._n_blocks, self.block,
+                                layout=self.layout)
 
     # ------------------------------------------------------------ lifecycle
 

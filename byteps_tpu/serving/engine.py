@@ -40,7 +40,13 @@ requests flow through:
     SHARES refcounted blocks (zero device copies — the copy/extract
     programs are never built), and pool exhaustion evicts prefix
     entries then preempts the newest request back to QUEUED (resume is
-    bit-exact; docs/serving.md "Paged KV cache").
+    bit-exact; docs/serving.md "Paged KV cache").  The gather is
+    pos-capped: each tick streams only the block high-water bucket,
+    never the null-padded table width.  With the **fused kernel**
+    (``paged_kernel``, ops/paged_attention.py) decode and spec-verify
+    skip the gather entirely — the Pallas kernel reads allocated,
+    position-covered blocks in place through the block table
+    (docs/serving.md "Fused paged attention").
   * **speculative decoding** (``spec_k > 0``, serving/spec.py) — the
     decode step generalized from 1 to ``k + 1`` query positions: a
     CPU-side n-gram proposer guesses up to ``k`` continuations from
@@ -293,6 +299,7 @@ class ServingEngine:
                  block: int = 16,
                  kv_mb: int = 0,
                  kv_blocks: Optional[int] = None,
+                 paged_kernel: str = "auto",
                  spec_k: int = 0,
                  spec_ngram: int = 3,
                  metrics: Optional[ServeMetrics] = None):
@@ -321,6 +328,44 @@ class ServingEngine:
         # write the span, scatter the touched blocks back — covers all
         # prefill, and the traced-position constraints below apply.
         self.paged = bool(paged)
+        # fused paged-attention kernel (ops/paged_attention.py): decode
+        # and spec-verify read allocated, position-covered blocks IN
+        # PLACE through the block table instead of gathering a dense
+        # row per slot per tick — the cache-stream copy the gather
+        # path pays is gone.  "auto" = on for paged engines on TPU
+        # (where the Mosaic kernel is compiled; the CPU fallback would
+        # run interpret-mode Pallas per tick and crawl), "on" forces
+        # it (CPU CI runs it in interpret mode for parity tests),
+        # "off" keeps the XLA gather.  Prefill chunks always ride the
+        # gather path — they run once per chunk, not once per tick.
+        pk = paged_kernel
+        if isinstance(pk, bool):
+            pk = "on" if pk else "off"
+        if pk not in ("auto", "on", "off"):
+            raise ValueError(
+                f"paged_kernel must be 'auto'|'on'|'off', got "
+                f"{paged_kernel!r}")
+        if self.paged and pk == "auto" and jax.default_backend() == "tpu":
+            # VMEM gate: the widest verify program's f32 accumulator
+            # ([ (spec_k+1)*H pad 16, KV*D ]) plus the double-buffered
+            # block pair must fit; an oversized config keeps the
+            # pos-capped gather instead of failing the Mosaic compile
+            # at the first decode tick ("on" forces past the gate)
+            from ..ops.paged_attention import paged_attention_usable
+
+            tq_max = (spec_k if spec_k and spec_k > 0 else 0) + 1
+            pk = ("auto" if paged_attention_usable(
+                (n_slots, tq_max, cfg.num_heads, cfg.d_head), block,
+                cfg.kv_heads * cfg.d_head) else "off")
+        self.paged_kernel = self.paged and (
+            pk == "on"
+            or (pk == "auto" and jax.default_backend() == "tpu"))
+        if self.paged and not self.paged_kernel and cache_layout == "flat":
+            raise ValueError(
+                "cache_layout='flat' on a paged engine requires the "
+                "fused paged-attention kernel (paged_kernel='on'): the "
+                "gather fallback would route flat rows through the "
+                "dense decode kernel under vmap")
         # chunk (and prefix-resumed, and every paged) prefill attends
         # at a TRACED position, which under kv_quant reads the
         # already-quantized int8 K/V — whole-prompt prefill at static
@@ -416,7 +461,8 @@ class ServingEngine:
             self.pool = PagedSlotPool(
                 cfg, n_slots, self.max_seq, block=block,
                 n_blocks=kv_blocks, kv_bytes=kv_mb << 20,
-                kv_quant=kv_quant, layout=cache_layout)
+                kv_quant=kv_quant,
+                layout=("flat" if self.paged_kernel else cache_layout))
         else:
             self.pool = SlotPool(cfg, n_slots, self.max_seq,
                                  kv_quant=kv_quant, layout=cache_layout)
@@ -533,11 +579,15 @@ class ServingEngine:
         # donate the cache pool into each step: the pool is replaced by
         # the step's output, and without donation XLA would copy every
         # layer's full [N, S, ...] cache (or [n_blocks, block, ...]
-        # block pool) per tick just to write one row
-        self._decode_step = jax.jit(
-            self._make_paged_decode_fn() if self.paged
-            else self._make_decode_fn(),
-            donate_argnums=(1,))
+        # block pool) per tick just to write one row.  Dense engines
+        # compile ONE decode program; paged engines compile one per
+        # gather high-water bucket (the chunk-bucket discipline —
+        # ``compile_counts()["decode_buckets"]`` pins it), or exactly
+        # one on the fused-kernel path.
+        self._decode_step = (
+            None if self.paged
+            else jax.jit(self._make_decode_fn(), donate_argnums=(1,)))
+        self._paged_decode_fns: Dict[object, object] = {}
         self._prefill_fns: Dict[int, object] = {}
         self._chunk_fns: Dict[int, object] = {}
         # verify programs, keyed by query width tq = depth + 1 — one
@@ -611,49 +661,89 @@ class ServingEngine:
 
         return decode_fn
 
-    def _make_paged_decode_fn(self):
-        """Paged twin of the decode step: per slot, gather the block
-        table's rows, run the SAME per-row decode (one attention
-        implementation — Transformer.decode_paged delegates to decode),
-        then scatter every slot's fresh K/V into the block pool at its
-        ``(write block, offset)`` target.  Masked slots (free or
-        PREFILLING) scatter into the null block, so their garbage write
-        can never touch a shared prefix block or a mid-prefill row —
-        simpler than the dense path's aim-at-the-cursor discipline."""
+    def _paged_decode_fn(self, hw: Optional[int]):
+        """Jitted paged decode step, two flavors:
+
+        * ``hw`` an int — the XLA **gather** fallback at that block
+          high-water bucket: per slot, gather ``table[:hw]``'s blocks
+          into a ``hw * block``-row dense view (NOT the full
+          ``max_seq`` width — the pos-capped gather stops streaming
+          null-block / unwritten padding), run the SAME per-row decode
+          (one attention implementation — ``Transformer.decode_paged``
+          delegates to ``decode``), then scatter every slot's fresh
+          K/V into the pool at its ``(write block, offset)`` target.
+          One compiled program per bucket, the chunk-bucket
+          discipline.
+        * ``hw is None`` — the **fused kernel** path: one un-vmapped
+          ``decode_paged_fused`` call serves the whole pool; fresh K/V
+          scatters into the pool inside the forward and the Pallas
+          kernel reads blocks in place through the table — no gather
+          exists.
+
+        Masked slots (free or PREFILLING) scatter into the null block
+        either way, so their garbage write can never touch a shared
+        prefix block or a mid-prefill row — simpler than the dense
+        path's aim-at-the-cursor discipline."""
+        key = "kernel" if hw is None else hw
+        fn = self._paged_decode_fns.get(key)
+        if fn is not None:
+            return fn
         model, greedy = self.model, self.greedy
         pad_id = self.pad_id
         select = self._select_token
 
-        def one(variables, pcaches, table, tok, pos, key):
-            logits, new_rows = model.apply(
-                variables, tok[None, None], pcaches, table, pos,
-                method=Transformer.decode_paged)
-            nxt, nk = select(logits[:, -1], key)
-            # the one written position, sliced back out of the gathered
-            # row for the pool scatter below
-            fresh = tuple(
-                {n: jax.lax.dynamic_slice_in_dim(r[n], pos, 1,
-                                                 axis=1)[0, 0]
-                 for n in r} for r in new_rows)
-            return fresh, nxt, nk
+        if hw is None:
+            def decode_fn(variables, pcaches, tok, pos, active, keys,
+                          tables, wblk, woff):
+                self.decode_traces += 1  # trace-time only
+                logits, new_pc = model.apply(
+                    variables, tok[:, None], pcaches, tables, pos,
+                    wblk, woff, True,
+                    method=Transformer.decode_paged_fused)
+                nxt, keys2 = jax.vmap(
+                    lambda lg, k: select(lg[None], k))(
+                        logits[:, -1], keys)
+                nxt = jnp.where(active, nxt, pad_id)
+                if not greedy:
+                    keys2 = jnp.where(active[:, None], keys2, keys)
+                else:
+                    keys2 = keys
+                return new_pc, nxt, keys2
+        else:
+            def one(variables, pcaches, table, tok, pos, key):
+                logits, new_rows = model.apply(
+                    variables, tok[None, None], pcaches, table, pos,
+                    hw_blocks=hw, method=Transformer.decode_paged)
+                nxt, nk = select(logits[:, -1], key)
+                # the one written position, sliced back out of the
+                # gathered row for the pool scatter below
+                fresh = tuple(
+                    {n: jax.lax.dynamic_slice_in_dim(r[n], pos, 1,
+                                                     axis=1)[0, 0]
+                     for n in r} for r in new_rows)
+                return fresh, nxt, nk
 
-        def decode_fn(variables, pcaches, tok, pos, active, keys,
-                      tables, wblk, woff):
-            self.decode_traces += 1  # trace-time only
-            fresh, nxt, keys2 = jax.vmap(
-                one, in_axes=(None, None, 0, 0, 0, 0))(
-                    variables, pcaches, tables, tok, pos, keys)
-            nxt = jnp.where(active, nxt, pad_id)
-            if not greedy:
-                keys2 = jnp.where(active[:, None], keys2, keys)
-            else:
-                keys2 = keys
-            new_pc = tuple(
-                {n: pc[n].at[wblk, woff].set(fr[n]) for n in pc}
-                for pc, fr in zip(pcaches, fresh))
-            return new_pc, nxt, keys2
+            def decode_fn(variables, pcaches, tok, pos, active, keys,
+                          tables, wblk, woff):
+                self.decode_traces += 1  # trace-time only
+                # the hw cap is applied in ONE place: decode_paged's
+                # hw_blocks slices each slot's table inside the vmap
+                fresh, nxt, keys2 = jax.vmap(
+                    one, in_axes=(None, None, 0, 0, 0, 0))(
+                        variables, pcaches, tables, tok, pos, keys)
+                nxt = jnp.where(active, nxt, pad_id)
+                if not greedy:
+                    keys2 = jnp.where(active[:, None], keys2, keys)
+                else:
+                    keys2 = keys
+                new_pc = tuple(
+                    {n: pc[n].at[wblk, woff].set(fr[n]) for n in pc}
+                    for pc, fr in zip(pcaches, fresh))
+                return new_pc, nxt, keys2
 
-        return decode_fn
+        fn = jax.jit(decode_fn, donate_argnums=(1,))
+        self._paged_decode_fns[key] = fn
+        return fn
 
     def _verify_accept(self, props, tmat, kchain, prop_len, active,
                        tok, keys, budget):
@@ -739,55 +829,82 @@ class ServingEngine:
         self._verify_fns[tq] = fn
         return fn
 
-    def _paged_verify_fn(self, tq: int):
-        """Paged twin of ``_verify_fn``: gather each slot's rows through
-        its block table, verify the ``tq``-position span, then scatter
-        the span's fresh K/V back **per position** to the host-computed
-        ``(block, offset)`` targets — touched blocks only, never a
-        whole-block rewrite, so a shared prefix block can never be
-        written (ungranted or masked positions aim at the null block,
-        and ``prop_len`` is pre-capped at the granted coverage so
-        acceptance can never advance a cursor onto an unwritten
-        position)."""
-        fn = self._verify_fns.get(tq)
+    def _paged_verify_fn(self, tq: int, hw: Optional[int]):
+        """Paged twin of ``_verify_fn``, two flavors like the decode
+        step.  Gather (``hw`` an int): gather ``table[:hw]``'s blocks
+        per slot (the pos-capped high-water bucket — never the full
+        null-padded width), verify the ``tq``-position span, then
+        scatter the span's fresh K/V back **per position** to the
+        host-computed ``(block, offset)`` targets — touched blocks
+        only, never a whole-block rewrite, so a shared prefix block can
+        never be written (ungranted or masked positions aim at the null
+        block, and ``prop_len`` is pre-capped at the granted coverage
+        so acceptance can never advance a cursor onto an unwritten
+        position).  Fused kernel (``hw is None``): the same program
+        shape as the kernel decode step, one query width wider —
+        plain decode and verify ride the SAME kernel, which is what
+        keeps spec-on token-identical to spec-off on this path."""
+        key = ("kernel", tq) if hw is None else (tq, hw)
+        fn = self._verify_fns.get(key)
         if fn is not None:
             return fn
         model = self.model
         select = self._select_token
 
-        def one(variables, pcaches, table, toks, pos, key):
-            logits, new_rows = model.apply(
-                variables, toks[None, :], pcaches, table, pos,
-                method=Transformer.verify_tokens_paged)
+        def chain(lg, key):
+            """Per-slot select chain over ``lg [tq, vocab]``."""
             ts, ks, k = [], [], key
             for i in range(tq):
-                t_i, k = select(logits[:, i], k)
+                t_i, k = select(lg[i][None], k)
                 ts.append(t_i)
                 ks.append(k)
-            # the tq written positions, sliced back out of the gathered
-            # row for the per-position pool scatter below
-            fresh = tuple(
-                {n: jax.lax.dynamic_slice_in_dim(r[n], pos, tq,
-                                                 axis=1)[0]
-                 for n in r} for r in new_rows)
-            return fresh, jnp.stack(ts), jnp.stack(ks)
+            return jnp.stack(ts), jnp.stack(ks)
 
-        def verify_fn(variables, pcaches, props, prop_len, pos, active,
-                      tok, keys, budget, tables, wblk, woff):
-            self.verify_traces += 1  # trace-time only
-            toks = jnp.concatenate([tok[:, None], props], axis=1)
-            fresh, tmat, kchain = jax.vmap(
-                one, in_axes=(None, None, 0, 0, 0, 0))(
-                    variables, pcaches, tables, toks, pos, keys)
-            new_pc = tuple(
-                {n: pc[n].at[wblk, woff].set(fr[n]) for n in pc}
-                for pc, fr in zip(pcaches, fresh))
-            return (new_pc,) + self._verify_accept(
-                props, tmat, kchain, prop_len, active, tok, keys,
-                budget)
+        if hw is None:
+            def verify_fn(variables, pcaches, props, prop_len, pos,
+                          active, tok, keys, budget, tables, wblk,
+                          woff):
+                self.verify_traces += 1  # trace-time only
+                toks = jnp.concatenate([tok[:, None], props], axis=1)
+                logits, new_pc = model.apply(
+                    variables, toks, pcaches, tables, pos, wblk, woff,
+                    method=Transformer.verify_tokens_paged_fused)
+                tmat, kchain = jax.vmap(chain)(logits, keys)
+                return (new_pc,) + self._verify_accept(
+                    props, tmat, kchain, prop_len, active, tok, keys,
+                    budget)
+        else:
+            def one(variables, pcaches, table, toks, pos, key):
+                logits, new_rows = model.apply(
+                    variables, toks[None, :], pcaches, table, pos,
+                    hw_blocks=hw,
+                    method=Transformer.verify_tokens_paged)
+                ts, ks = chain(logits[0], key)
+                # the tq written positions, sliced back out of the
+                # gathered row for the per-position pool scatter below
+                fresh = tuple(
+                    {n: jax.lax.dynamic_slice_in_dim(r[n], pos, tq,
+                                                     axis=1)[0]
+                     for n in r} for r in new_rows)
+                return fresh, ts, ks
+
+            def verify_fn(variables, pcaches, props, prop_len, pos,
+                          active, tok, keys, budget, tables, wblk,
+                          woff):
+                self.verify_traces += 1  # trace-time only
+                toks = jnp.concatenate([tok[:, None], props], axis=1)
+                fresh, tmat, kchain = jax.vmap(
+                    one, in_axes=(None, None, 0, 0, 0, 0))(
+                        variables, pcaches, tables, toks, pos, keys)
+                new_pc = tuple(
+                    {n: pc[n].at[wblk, woff].set(fr[n]) for n in pc}
+                    for pc, fr in zip(pcaches, fresh))
+                return (new_pc,) + self._verify_accept(
+                    props, tmat, kchain, prop_len, active, tok, keys,
+                    budget)
 
         fn = jax.jit(verify_fn, donate_argnums=(1,))
-        self._verify_fns[tq] = fn
+        self._verify_fns[key] = fn
         return fn
 
     def _paged_chunk_fn(self, bucket: int):
@@ -1512,6 +1629,28 @@ class ServingEngine:
                               digests=req._prefix_digs):
             self.metrics.bump(sm.PREFIX_INSERTIONS)
 
+    def _gather_hw(self, tq: int) -> int:
+        """Block high-water bucket for the XLA gather fallback: the
+        smallest power-of-two block count (capped at ``max_blocks``)
+        covering every assigned slot's ``[0, pos + tq)`` span this tick
+        — masked slots sit at pos 0 and still land their ``tq``-wide
+        garbage write inside the view.  Bucketing keeps the compile
+        count O(log max_blocks) (the prefill-bucket discipline) while
+        the gather stops streaming the null-block / unwritten padding
+        beyond the highest live cursor."""
+        blk = self.pool.block
+        need = tq
+        for slot in self.pool.active_slots():
+            if slot in self._prefilling:
+                # PREFILLING slots are masked out of paged decode AND
+                # verify (their pos vector entry is 0, their garbage
+                # write aims at the null block), so their — possibly
+                # deep — prefill cursor must not drag every
+                # interleaved decode tick back to full gather width
+                continue
+            need = max(need, self.pool.pos[slot] + tq)
+        return _next_bucket(-(-need // blk), 1, self.pool.max_blocks)
+
     def _decode_tick(self, active: List[int]) -> int:
         n = self.pool.n_slots
         if self.paged:
@@ -1552,11 +1691,27 @@ class ServingEngine:
             woff = np.zeros((n,), np.int32)
             for slot in active:
                 wblk[slot], woff[slot] = self.pool.write_target(slot)
-            caches, nxt, keys = self._decode_step(
-                self.variables, self.pool.caches, self._tok,
-                jnp.asarray(pos), jnp.asarray(mask), self._keys,
-                self.pool.tables_device(), jnp.asarray(wblk),
-                jnp.asarray(woff))
+            if self.paged_kernel:
+                # fused kernel: one program, write targets per (slot,
+                # query) — tq = 1 here — and NO gather anywhere
+                fn = self._paged_decode_fn(None)
+                caches, nxt, keys = fn(
+                    self.variables, self.pool.caches, self._tok,
+                    jnp.asarray(pos), jnp.asarray(mask), self._keys,
+                    self.pool.tables_device(),
+                    jnp.asarray(wblk[:, None]),
+                    jnp.asarray(woff[:, None]))
+            else:
+                # pos-capped gather: stream each slot's high-water
+                # bucket, not the full null-padded table width
+                hw = self._gather_hw(1)
+                self.metrics.bump(sm.GATHERED_BLOCKS, n * hw)
+                fn = self._paged_decode_fn(hw)
+                caches, nxt, keys = fn(
+                    self.variables, self.pool.caches, self._tok,
+                    jnp.asarray(pos), jnp.asarray(mask), self._keys,
+                    self.pool.tables_device(), jnp.asarray(wblk),
+                    jnp.asarray(woff))
         else:
             # PREFILLING slots ride the decode step masked-off like
             # freed slots do, but their garbage K/V write must NOT land
@@ -1700,7 +1855,12 @@ class ServingEngine:
                     p_ = int(posv[slot]) + j
                     wblk[slot, j] = table[p_ // blk]
                     woff[slot, j] = p_ % blk
-            fn = self._paged_verify_fn(tq)
+            if self.paged_kernel:
+                fn = self._paged_verify_fn(tq, None)
+            else:
+                hw = self._gather_hw(tq)
+                self.metrics.bump(sm.GATHERED_BLOCKS, n * hw)
+                fn = self._paged_verify_fn(tq, hw)
             out = fn(self.variables, self.pool.caches,
                      jnp.asarray(pmat), jnp.asarray(plen),
                      jnp.asarray(posv), jnp.asarray(mask), self._tok,
@@ -1905,10 +2065,15 @@ class ServingEngine:
 
     def compile_counts(self) -> Dict[str, int]:
         """Trace counts of the step programs — steady-state serving must
-        keep ``decode`` at 1, ``prefill``/``chunk``/``verify`` at the
-        number of distinct buckets touched, and the prefix copy/extract
-        programs at 1 each (asserted by tests and bench_serve.py)."""
+        keep ``decode`` at ``decode_buckets`` (1 for dense engines and
+        the fused-kernel paged path; the number of gather high-water
+        buckets touched on the paged XLA fallback),
+        ``prefill``/``chunk``/``verify`` at the number of distinct
+        buckets touched, and the prefix copy/extract programs at 1 each
+        (asserted by tests and bench_serve.py)."""
         return {"decode": self.decode_traces,
+                "decode_buckets": (len(self._paged_decode_fns)
+                                   if self.paged else 1),
                 "prefill": self.prefill_traces,
                 "prefill_buckets": len(self._prefill_fns),
                 "chunk": self.chunk_traces,

@@ -50,6 +50,12 @@ KV_BLOCKS_USED = "serve.kv_blocks_used"
 KV_BLOCKS_SHARED = "serve.kv_blocks_shared"
 BLOCK_EVICTIONS = "serve.block_evictions"
 PREEMPTIONS = "serve.preemptions"
+# blocks the XLA gather fallback materialized into dense rows this
+# tick (n_slots x high-water bucket, decode AND verify passes):
+# GATHERED_BLOCKS * pool.block_bytes is the per-tick cache-stream copy
+# the pos-capped gather shrinks and the fused kernel eliminates —
+# bench_serve.py --paged reports the reduction (serve_paged_kernel)
+GATHERED_BLOCKS = "serve.gathered_blocks"
 # speculative decoding (serving/engine.py spec_k > 0, serving/spec.py):
 # DECODE_TICKS counts ticks that ran a decode/verify forward (the
 # denominator of tokens-per-tick — what speculation exists to raise);

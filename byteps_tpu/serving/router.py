@@ -203,7 +203,8 @@ class ServeRouter:
                  heartbeat_interval: float = 0.5,
                  miss_threshold: int = 3,
                  ping_timeout: float = 1.0,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 expected_weights_fp: Optional[str] = None):
         if not replicas:
             raise ValueError(
                 "ServeRouter needs at least one replica address "
@@ -239,7 +240,16 @@ class ServeRouter:
         for r in self._replicas:
             self._gauge_state(r)
 
-        self._expected_fp: Optional[str] = None
+        # the tier's weights anchor.  Default: first-verified-wins —
+        # the first fingerprint a replica proves becomes the tier's.
+        # ``expected_weights_fp`` (BYTEPS_ROUTER_WEIGHTS_FP) lets the
+        # operator PIN the anchor instead: WHICH checkpoint wins is
+        # then an explicit deployment decision, not an accident of
+        # which replica registered first, and a replica that cannot
+        # prove the pinned fingerprint (including pre-handshake builds
+        # that report none) is refused placement.
+        self._expected_fp: Optional[str] = expected_weights_fp or None
+        self._fp_pinned = bool(expected_weights_fp)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -249,8 +259,11 @@ class ServeRouter:
         Registration compares every reachable replica's STATS weights
         fingerprint (the same digest the prefix-store salt commits to —
         serving/prefix.py ``weights_fingerprint``): the first fingerprint
-        seen becomes the tier's, and a disagreeing replica raises the
-        typed :class:`WeightsMismatchError` — refusing to build a tier
+        seen becomes the tier's — unless the operator pinned the anchor
+        via ``expected_weights_fp`` (BYTEPS_ROUTER_WEIGHTS_FP), in which
+        case every replica must prove THAT checkpoint — and a
+        disagreeing replica raises the typed
+        :class:`WeightsMismatchError`: refusing to build a tier
         whose failover re-dispatch would splice tokens from different
         checkpoints.  Replicas unreachable right now are re-checked on
         their first successful ping and at failback."""
@@ -298,28 +311,41 @@ class ServeRouter:
         except (OSError, ValueError, RuntimeError):
             return False  # unreachable: re-checked at ping/failback
         with self._lock:
-            if fp is None:
+            if fp is None and not self._fp_pinned:
+                # no fingerprint, no pin: the operator-guarantees-
+                # homogeneity contract pre-handshake builds were
+                # deployed under
                 r.verified = True
                 r.refused = False
                 return True
-            if self._expected_fp is None:
-                self._expected_fp = fp
-            if fp == self._expected_fp:
-                r.verified = True
-                r.refused = False
-                return True
+            if fp is not None:
+                if self._expected_fp is None:
+                    self._expected_fp = fp
+                if fp == self._expected_fp:
+                    r.verified = True
+                    r.refused = False
+                    return True
             first_refusal = not r.refused
             r.refused = True
             r.verified = True
         if first_refusal:
             self._bump(WEIGHTS_REFUSED)
         self._gauge_state(r)
-        msg = (f"replica {r.idx} ({r.addr}) serves different weights "
-               f"(fingerprint {fp[:16]}... != tier "
-               f"{self._expected_fp[:16]}...): refusing placement — a "
-               f"mid-stream re-dispatch onto it would splice a "
-               f"silently-wrong continuation.  Restart it on the "
-               f"tier's checkpoint to re-admit it.")
+        if fp is None:
+            msg = (f"replica {r.idx} ({r.addr}) reports no weights "
+                   f"fingerprint but the operator pinned "
+                   f"BYTEPS_ROUTER_WEIGHTS_FP="
+                   f"{self._expected_fp[:16]}...: refusing placement — "
+                   f"an unverifiable replica cannot prove it serves "
+                   f"the pinned checkpoint.")
+        else:
+            msg = (f"replica {r.idx} ({r.addr}) serves different "
+                   f"weights (fingerprint {fp[:16]}... != "
+                   f"{'pinned' if self._fp_pinned else 'tier'} "
+                   f"{self._expected_fp[:16]}...): refusing placement "
+                   f"— a mid-stream re-dispatch onto it would splice "
+                   f"a silently-wrong continuation.  Restart it on "
+                   f"the tier's checkpoint to re-admit it.")
         if raising:
             raise WeightsMismatchError(msg)
         bps_log.warning("router: %s", msg)
@@ -847,6 +873,7 @@ def router_from_env(env=None) -> int:
         stream_timeout=cfg.router_stream_timeout_ms / 1e3,
         heartbeat_interval=cfg.router_heartbeat_ms / 1e3,
         miss_threshold=cfg.router_miss_threshold,
-        ping_timeout=cfg.heartbeat_timeout_ms / 1e3)
+        ping_timeout=cfg.heartbeat_timeout_ms / 1e3,
+        expected_weights_fp=cfg.router_weights_fp or None)
     serve_router(router, cfg.router_port)
     return 0

@@ -196,6 +196,7 @@ def run(requests: int = 12, seed: int = 0, n_slots: int = 4,
     counts = engine.compile_counts()
     stats = {"requests": requests, "mismatches": mismatches,
              "decode_traces": counts["decode"],
+             "decode_buckets": counts["decode_buckets"],
              "prefill_buckets": counts["prefill_buckets"],
              "chunk_buckets": counts["chunk_buckets"],
              "verify_traces": counts["verify"],
@@ -237,7 +238,11 @@ def main(argv=None) -> int:
                     n_slots=args.slots, temperature=temp,
                     prefix_share=args.prefix_share, paged=args.paged,
                     spec=args.spec)
-        ok = ok and stats["mismatches"] == 0 and stats["decode_traces"] == 1
+        # paged engines compile one decode program per gather
+        # high-water bucket (pos-capped gather); dense engines exactly
+        # one — either way, traces == buckets pins retrace-freedom
+        ok = (ok and stats["mismatches"] == 0
+              and stats["decode_traces"] == stats["decode_buckets"])
         if args.prefix_share:
             ok = ok and stats.get("serve.prefix_hits", 0) > 0
         if args.paged:

@@ -698,3 +698,41 @@ def test_router_weights_handshake_on_ping_and_failback(tiny, prompts,
         bad_srv.server_close()
         good_srv2.shutdown()
         good_srv2.server_close()
+
+
+def test_router_operator_pinned_fingerprint(tiny, prompts, greedy_base,
+                                            replica_pair):
+    """BYTEPS_ROUTER_WEIGHTS_FP pins the tier's weights anchor: WHICH
+    checkpoint wins is the operator's explicit decision, not an
+    accident of registration order.  Replicas proving the pinned
+    fingerprint place normally; a tier whose replicas all agree with
+    each other but NOT with the pin is refused typed — the exact
+    scenario first-verified-wins cannot catch."""
+    _, srvs, addrs = replica_pair
+    c = RemoteServeClient(addrs[0])
+    fp = c.stats()["weights_fingerprint"]
+    c.close()
+    # pin the RIGHT fingerprint: registration verifies, traffic flows
+    router = _router(addrs, expected_weights_fp=fp).start()
+    try:
+        assert router._expected_fp == fp
+        assert all(r.verified and not r.refused
+                   for r in router._replicas)
+        np.testing.assert_array_equal(router.generate(prompts[0], M),
+                                      greedy_base[0])
+    finally:
+        router.close()
+    # pin a WRONG fingerprint: both replicas agree with each other,
+    # and both are refused anyway — the pin overrides the
+    # first-verified-wins anchoring, reusing the typed refusal path
+    router = _router(addrs, expected_weights_fp="00" * 16)
+    try:
+        with pytest.raises(rt.WeightsMismatchError, match="pinned"):
+            router.start()
+        assert router._replicas[0].refused
+        assert not router._replicas[0].placeable
+        assert router.stats()[rt.WEIGHTS_REFUSED] >= 1
+        # the pinned anchor never drifts onto an observed fingerprint
+        assert router._expected_fp == "00" * 16
+    finally:
+        router.close()
