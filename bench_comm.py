@@ -68,14 +68,7 @@ import optax  # noqa: E402
 from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
 
 
-def _free_port():
-    import socket as _socket
-
-    s = _socket.socket()
-    s.bind(("127.0.0.1", 0))
-    p = s.getsockname()[1]
-    s.close()
-    return p
+from byteps_tpu.engine.transport import free_port as _free_port  # noqa: E402
 
 
 def _wait_port(p):

@@ -824,6 +824,165 @@ def router_failover(requests: int = 12, tokens: int = 24,
     return row
 
 
+def router_ha(requests: int = 12, tokens: int = 24,
+              prompt_len: int = 12, slots: int = 6,
+              d_model: int = 128, layers: int = 2,
+              vocab: int = 256, kill_after: int = 2,
+              out_path: str = "BENCH_SERVE.json",
+              archive: bool = True):
+    """Router-HA A/B (docs/serving.md "Router HA"): the same threaded
+    workload through the ROUTER TIER — 2 routers (active + journal-fed
+    standby) over 2 replicas, clients holding the multi-router address
+    list — steady-state vs with the ACTIVE ROUTER killed mid-run.
+    Reports completion rate, mismatches, and TTFT p50/p99 for both
+    legs: the claim is that losing the router itself degrades tail
+    latency (the takeover window), never correctness or completion —
+    every request is token-identical to the greedy generate()
+    reference, recovered through client-side failover + the journaled
+    takeover."""
+    from byteps_tpu.observability.metrics import MetricsRegistry
+    from byteps_tpu.resilience.policy import RetryPolicy
+    from byteps_tpu.serving import RemoteServeClient, ServeRouter
+    from byteps_tpu.serving import router as rt
+    from byteps_tpu.serving.frontend import serve
+    from byteps_tpu.serving.router import RouterFrontend
+
+    from byteps_tpu.engine.transport import free_port as _free_port
+
+    cfg = TransformerConfig(vocab_size=vocab, num_layers=layers,
+                            num_heads=4, d_model=d_model,
+                            d_ff=2 * d_model, max_seq_len=64,
+                            dtype=jnp.float32)
+    model = Transformer(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    prompts = _prompts(requests, prompt_len, vocab)
+    refs = [list(np.asarray(generate(
+        model, variables, p[None], tokens,
+        temperature=0.0)["tokens"])[0]) for p in prompts]
+
+    def run_leg(kill: bool):
+        engines = [ServingEngine(model, variables, n_slots=slots,
+                                 max_seq=64, metrics=ServeMetrics())
+                   for _ in range(2)]
+        for e in engines:
+            e.start()
+            e.submit(prompts[0], 2).result(timeout=120.0)
+        srvs = [serve(e, 0, host="127.0.0.1", in_thread=True)[0]
+                for e in engines]
+        rep_addrs = ["127.0.0.1:%d" % s.server_address[1]
+                     for s in srvs]
+        pa, pb = _free_port(), _free_port()
+        peers = ["127.0.0.1:%d" % pa, "127.0.0.1:%d" % pb]
+
+        def mk_router(self_addr):
+            return ServeRouter(
+                rep_addrs, affinity=False, credits=slots,
+                deadline=60.0, stream_timeout=10.0,
+                heartbeat_interval=0.1, miss_threshold=2,
+                ping_timeout=1.0, registry=MetricsRegistry(),
+                retry=RetryPolicy(max_attempts=8, backoff_base=0.05,
+                                  jitter=0.1, deadline=0.0),
+                peers=peers, self_addr=self_addr, epoch_timeout=0.2)
+
+        ra, rb = mk_router(peers[0]), mk_router(peers[1])
+        fa = RouterFrontend(("127.0.0.1", pa), ra)
+        fb = RouterFrontend(("127.0.0.1", pb), rb)
+        for f in (fa, fb):
+            threading.Thread(target=f.serve_forever,
+                             daemon=True).start()
+        ttft, tpot, done = [], [], []
+        lock = threading.Lock()
+
+        def worker(i):
+            t0 = time.perf_counter()
+            first = None
+            toks = []
+            cli = None
+            try:
+                cli = RemoteServeClient(",".join(peers), timeout=60.0)
+                for tok in cli.stream(prompts[i], tokens):
+                    if first is None:
+                        first = time.perf_counter()
+                    toks.append(int(tok))
+                ok = toks == refs[i]
+            except Exception:
+                ok = False
+            finally:
+                if cli is not None:
+                    cli.close()
+            t1 = time.perf_counter()
+            with lock:
+                if first is not None:
+                    ttft.append(first - t0)
+                    if len(toks) > 1:
+                        tpot.append((t1 - first) / (len(toks) - 1))
+                done.append(ok)
+
+        threads = [threading.Thread(target=worker, args=(i,),
+                                    daemon=True)
+                   for i in range(requests)]
+        killer = None
+        if kill:
+            def _killer():
+                while True:
+                    with lock:
+                        if len(done) >= kill_after:
+                            break
+                    time.sleep(0.002)
+                fa.kill()
+
+            killer = threading.Thread(target=_killer, daemon=True)
+            killer.start()
+        try:
+            for t in threads:
+                t.start()
+                time.sleep(0.04)
+            for t in threads:
+                t.join(120.0)
+            if killer is not None:
+                killer.join(60.0)
+            st = rb.stats() if kill else ra.stats()
+            return {"completed": sum(done),
+                    "mismatches": sum(not ok for ok in done),
+                    "ttft_p50_s": _pctl(ttft, 50),
+                    "ttft_p99_s": _pctl(ttft, 99),
+                    "tpot_p50_s": _pctl(tpot, 50),
+                    "tpot_p99_s": _pctl(tpot, 99),
+                    "takeovers": st[rt.TAKEOVERS],
+                    "standby_refused": st[rt.STANDBY_REFUSED],
+                    "epoch": st["epoch"]}
+        finally:
+            for f, was_killed in ((fa, kill), (fb, False)):
+                if not was_killed:
+                    try:
+                        f.kill()
+                    except Exception:
+                        pass
+            for s in srvs:
+                try:
+                    s.shutdown()
+                    s.server_close()
+                except Exception:
+                    pass
+
+    steady = run_leg(False)
+    ha = run_leg(True)
+    row = {"metric": "serve_router_ha", "requests": requests,
+           "tokens": tokens, "routers": 2, "replicas": 2,
+           "slots": slots, "d_model": d_model, "layers": layers,
+           "steady": steady, "router_kill": ha,
+           "completion_rate": ha["completed"] / requests,
+           # the honest takeover cost: tail TTFT during the takeover
+           # window vs the steady-state median
+           "takeover_ttft_p99_vs_steady_p50": round(
+               ha["ttft_p99_s"] / max(steady["ttft_p50_s"], 1e-9), 2)}
+    print(json.dumps(row), flush=True)
+    if archive:
+        _archive_rows([row], out_path)
+    return row
+
+
 def router_affinity(groups: int = 3, per_group: int = 8,
                     shared_len: int = 64, tail_len: int = 6,
                     tokens: int = 8, slots: int = 4,
@@ -941,6 +1100,11 @@ def main(argv=None) -> int:
     ap.add_argument("--router-affinity", action="store_true",
                     help="run only the router placement A/B (prefix-"
                          "affinity vs round-robin prefix hit rate)")
+    ap.add_argument("--router-ha", action="store_true",
+                    help="run only the router-HA A/B (2 routers + "
+                         "standby journal: steady vs mid-run ACTIVE-"
+                         "ROUTER kill; completion rate, mismatches, "
+                         "takeover-window TTFT tail)")
     ap.add_argument("--spec", action="store_true",
                     help="run only the speculative-decoding A/B "
                          "(repetitive leg: accepted-tokens/tick + TPOT "
@@ -976,6 +1140,20 @@ def main(argv=None) -> int:
               f"{row['steady']['ttft_p99_s']}s "
               f"({'PASS' if ok else 'FAIL'} all complete, 0 "
               f"mismatches)")
+        return 0 if ok else 1
+    if args.router_ha:
+        row = router_ha(requests=args.requests, out_path=args.out,
+                        archive=not args.no_archive)
+        ha = row["router_kill"]
+        ok = (ha["completed"] == args.requests
+              and ha["mismatches"] == 0 and ha["takeovers"] == 1)
+        print(f"router HA: {ha['completed']}/{args.requests} completed "
+              f"across an ACTIVE-ROUTER kill (epoch {ha['epoch']}), "
+              f"takeover TTFT p99 {ha['ttft_p99_s']}s vs steady p50 "
+              f"{row['steady']['ttft_p50_s']}s "
+              f"({row['takeover_ttft_p99_vs_steady_p50']}x) "
+              f"({'PASS' if ok else 'FAIL'} all complete, 0 "
+              f"mismatches, takeover fired)")
         return 0 if ok else 1
     if args.router_affinity:
         row = router_affinity(out_path=args.out,

@@ -202,6 +202,25 @@ class Config:
     # anchoring; set it and the tier refuses ANY replica that does not
     # prove this exact checkpoint (docs/serving.md "Weights handshake")
     router_weights_fp: str = ""
+    # --- router high availability (docs/serving.md "Router HA"):
+    # priority-ordered router addresses "host:port,host:port" (index 0
+    # is initially active; standbys receive the state journal and the
+    # highest-priority live one takes over on active death).  "" = a
+    # single router, no replication.
+    router_peers: str = ""
+    # this router's own entry in router_peers (required when peers are
+    # set — priority is positional, so every router must know its slot)
+    router_self: str = ""
+    # takeover grace window: after a standby's detector declares every
+    # higher-priority router dead, it re-pings them once this many ms
+    # later and only then assumes the epoch (a transiently-stalled
+    # active must not trigger a takeover it would immediately fence)
+    router_epoch_timeout_ms: float = 500.0
+    # per-tenant fair-share weights "tenant=w,tenant=w" for the
+    # router's in-flight credit pools (requests tag themselves with
+    # the tenant= submit param; unknown/untagged share the "default"
+    # bucket, weight 1 unless configured).  "" = fair share off.
+    router_tenant_weights: str = ""
 
     # --- pipelined wire engine (byteps_tpu/engine/wire.py; the client
     # half of the push/pull pipelining BytePS keeps the wire busy with —
@@ -335,6 +354,12 @@ class Config:
             router_miss_threshold=_env_int(
                 "BYTEPS_ROUTER_MISS_THRESHOLD", 3),
             router_weights_fp=_env_str("BYTEPS_ROUTER_WEIGHTS_FP", ""),
+            router_peers=_env_str("BYTEPS_ROUTER_PEERS", ""),
+            router_self=_env_str("BYTEPS_ROUTER_SELF", ""),
+            router_epoch_timeout_ms=_env_float(
+                "BYTEPS_ROUTER_EPOCH_TIMEOUT_MS", 500.0),
+            router_tenant_weights=_env_str(
+                "BYTEPS_ROUTER_TENANT_WEIGHTS", ""),
             wire_window=_env_int("BYTEPS_WIRE_WINDOW", 8),
             wire_fanout=_env_int("BYTEPS_WIRE_FANOUT", 16),
             transport=_env_str("BYTEPS_TRANSPORT", "auto"),

@@ -19,6 +19,7 @@ applied *statically* via ``BucketPlan.schedule_order()``.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, List, Optional
 
 from . import logging as bps_log
@@ -163,6 +164,23 @@ class ScheduledQueue:
             if self._is_scheduled:
                 self._credits += n
                 self._cv.notify_all()
+
+    def debit_wait(self, n: int, timeout: float) -> bool:
+        """:meth:`try_debit`'s blocking form: wait up to ``timeout``
+        seconds for ``n`` credits and consume them — woken by
+        :meth:`credit`/:meth:`report_finish` instead of the caller
+        polling.  Returns False on timeout or a closed queue."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            if not self._is_scheduled:
+                return True
+            while n > self._credits:
+                left = deadline - time.monotonic()
+                if left <= 0 or self._closed:
+                    return False
+                self._cv.wait(left)
+            self._credits -= n
+            return True
 
     def remove(self, task: TensorTaskEntry) -> bool:
         """Remove a still-pending task without granting it (eager

@@ -231,6 +231,15 @@ def resolve_transport(addr: str, spec: str,
 _UDS_BUF = 4 * 1024 * 1024
 
 
+def free_port() -> int:
+    """Grab an ephemeral loopback TCP port (bind-and-release).  The
+    one implementation behind every test/bench/chaos harness that
+    spawns endpoints on fresh ports."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 def maybe_nodelay(sock) -> None:
     """Per-family socket tuning: TCP_NODELAY on TCP (a UDS/shm endpoint
     has no Nagle to disable), big send/recv buffers on AF_UNIX (no

@@ -24,7 +24,15 @@ The same env names keep working so reference run scripts port directly:
                                            serving router over
                                            BYTEPS_ROUTER_REPLICAS
                                            (serving/router.py, knobs
-                                           BYTEPS_ROUTER_*); otherwise
+                                           BYTEPS_ROUTER_*; for router
+                                           HA give every router the
+                                           same priority-ordered
+                                           BYTEPS_ROUTER_PEERS list
+                                           plus its own
+                                           BYTEPS_ROUTER_SELF entry —
+                                           index 0 starts active, the
+                                           rest are journal-fed
+                                           standbys); otherwise
                                            server/scheduler exit 0 with a
                                            notice (sync mode needs no tier)
   BYTEPS_ENABLE_GDB=1                   -> wrap the command in gdb

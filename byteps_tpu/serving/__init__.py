@@ -41,8 +41,13 @@ kernels into a *serving engine*:
     replicas (``launcher.py`` role ``router``): health-checked
     failover with deterministic mid-stream re-dispatch (a dead
     replica's requests resume token-identically on a survivor),
-    prefix-affinity placement, per-replica credit backpressure, and
-    graceful drain — docs/serving.md "Router tier";
+    prefix-affinity placement, per-replica credit backpressure,
+    per-tenant fair-share credits, and graceful drain — and the
+    router itself is no single point of failure: standbys follow an
+    ``OP_JOURNAL`` state stream (``journal``), take over
+    deterministically at a fenced epoch on active death, and
+    multi-router clients re-issue mid-stream with ``resume`` —
+    docs/serving.md "Router tier" / "Router HA";
   * ``metrics`` — TTFT/TPOT/queue-wait and occupancy/tokens-per-sec
     counters exported through the process ``Tracer``.
 
@@ -57,19 +62,27 @@ from .blocks import (  # noqa: F401
     BlockTable,
     PagedSlotPool,
 )
-from .engine import Request, RequestState, ServingEngine  # noqa: F401
+from .engine import (  # noqa: F401
+    EpochFencedError,
+    Request,
+    RequestState,
+    ServingEngine,
+)
 from .frontend import (  # noqa: F401
     RemoteServeClient,
     ServeClient,
     ServeConnectionError,
+    ServeReplyError,
     serve,
     serve_from_env,
 )
+from .journal import JournalSender  # noqa: F401
 from .metrics import ServeMetrics, get_serve_metrics  # noqa: F401
 from .router import (  # noqa: F401
     ReplicaLostError,
     ReplicaState,
     RouterFrontend,
+    RouterStandbyError,
     ServeRouter,
     WeightsMismatchError,
     router_from_env,

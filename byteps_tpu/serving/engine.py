@@ -85,6 +85,7 @@ entire output is deterministic.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import enum
 import hashlib
@@ -108,7 +109,25 @@ from .scheduler import ServeScheduler
 from .slots import SlotPool
 from .spec import NgramProposer
 
-__all__ = ["Request", "RequestState", "ServingEngine"]
+__all__ = ["EpochFencedError", "Request", "RequestState", "ServingEngine"]
+
+
+class EpochFencedError(RuntimeError):
+    """A dispatch carried a router epoch LOWER than one this engine has
+    already served: the sender is a deposed active router that does not
+    yet know a standby took over (serving/router.py "Router HA").  The
+    refusal is the split-brain guard — accepting the stale dispatch
+    could double-serve a request the new epoch's router already
+    re-dispatched.  Typed so the stale router can recognize the fence
+    and demote itself instead of treating this replica as dead."""
+
+    def __init__(self, epoch: int, high_water: int):
+        self.epoch = epoch
+        self.high_water = high_water
+        super().__init__(
+            f"dispatch fenced: epoch {epoch} < this engine's epoch "
+            f"high-water {high_water} — a newer router epoch has taken "
+            f"over this tier; the sending router must demote")
 
 
 class RequestState(enum.Enum):
@@ -550,6 +569,12 @@ class ServingEngine:
         self._trace_rpc = rpc_tracing_enabled()
 
         self._lock = threading.RLock()
+        # router-epoch fence (serving/router.py "Router HA"): the
+        # highest epoch any dispatch has carried.  Its own small lock —
+        # the fence check runs on frontend handler threads before
+        # submit and must never contend with the tick loop
+        self._epoch_lock = threading.Lock()
+        self._epoch_hw = 0
         self._req_seq = 0
         self._slot_req: List[Optional[Request]] = [None] * n_slots
         # slots mid-chunked-prefill: assigned (cache rows being written)
@@ -1047,11 +1072,51 @@ class ServingEngine:
 
     # ------------------------------------------------------------- submit
 
+    @contextlib.contextmanager
+    def epoch_fence(self, epoch: int):
+        """Check-and-record a router dispatch's epoch ATOMICALLY with
+        the admission or cancel performed inside the ``with`` block: any
+        epoch LOWER than the high-water already seen raises the typed
+        :class:`EpochFencedError` (the frontend turns it into a status=1
+        reply).  Equal epochs are fine — the active router stamps every
+        dispatch with its current epoch.  The fencing-token discipline:
+        once a takeover router's first dispatch lands here, the deposed
+        epoch can never place (or cancel) work on this engine again, so
+        a request the new epoch re-dispatched cannot also be driven by
+        its old leg (docs/serving.md "Router HA").  The lock is held
+        across the body — a bare check-then-act would leave a window
+        where a deposed router's dispatch passes the check, the takeover
+        epoch's first dispatch lands, and the stale action still runs
+        afterward, the exact interleaving the fence exists to refuse."""
+        epoch = int(epoch)
+        with self._epoch_lock:
+            if epoch < self._epoch_hw:
+                raise EpochFencedError(epoch, self._epoch_hw)
+            self._epoch_hw = epoch
+            yield
+
+    def fence_epoch(self, epoch: int) -> None:
+        """Point-in-time epoch check (see :meth:`epoch_fence`; dispatch
+        paths that admit or cancel work must use the context-manager
+        form so the check is atomic with the action)."""
+        with self.epoch_fence(epoch):
+            pass
+
+    @property
+    def epoch_high_water(self) -> int:
+        with self._epoch_lock:
+            return self._epoch_hw
+
     def submit(self, prompt, max_new_tokens: int, *, seed: int = 0,
-               priority: int = 0, resume_tokens=None) -> Request:
+               priority: int = 0, resume_tokens=None,
+               epoch: Optional[int] = None) -> Request:
         """Enqueue a generation request.  Raises ``ValueError`` on an
         infeasible request and ``QueueFullError`` (typed backpressure)
         when the bounded admission queue is at capacity.
+
+        ``epoch`` (router dispatches only) runs the whole admission
+        under :meth:`epoch_fence`, so a stale-epoch dispatch racing the
+        takeover epoch's first dispatch is refused, never admitted.
 
         ``resume_tokens`` resumes a request another engine already
         emitted ``k`` tokens for (the router's cross-replica failover,
@@ -1066,6 +1131,16 @@ class ServingEngine:
         ``k``); ``max_new_tokens`` stays the request's TOTAL budget and
         the resumed tokens count against it (only new tokens are
         streamed; ``result()`` returns the full sequence)."""
+        if epoch is not None:
+            with self.epoch_fence(epoch):
+                return self._submit(prompt, max_new_tokens, seed=seed,
+                                    priority=priority,
+                                    resume_tokens=resume_tokens)
+        return self._submit(prompt, max_new_tokens, seed=seed,
+                            priority=priority, resume_tokens=resume_tokens)
+
+    def _submit(self, prompt, max_new_tokens: int, *, seed: int,
+                priority: int, resume_tokens) -> Request:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         T = int(prompt.shape[0])
         if T < 1:

@@ -29,6 +29,29 @@ except STREAM whose reply is a frame *sequence*):
                 what lets the router record how far a stream got before
                 a replica died — the failover re-dispatch resumes from
                 exactly the tokens that crossed the wire.
+    4 = CANCEL  name = JSON {"rid"}: eagerly cancel the in-flight
+                request that was submitted with that caller-chosen
+                ``rid`` param — from a SECOND connection, since the
+                streaming one is busy relaying tokens.  The engine's
+                eager cancel reclaims the slot (and paged KV blocks)
+                same-tick; the cancelled stream ends with its normal
+                "end" frame carrying whatever was emitted.  A rid that
+                has not arrived yet is tombstoned so a cancel racing
+                its own submit still lands (bounded set).  Reply
+                payload = JSON {"cancelled": bool}.
+    5 = JOURNAL name = JSON list of router-HA journal entries (routers
+                only — serving/router.py streams active-router state to
+                standbys through it; a plain serve frontend answers
+                "bad op").  Reply payload = JSON {"epoch": receiver's
+                epoch} — how a deposed active discovers a takeover
+                happened (split-brain guard on the journal path).
+
+SUBMIT/STREAM params may also carry ``epoch`` (the dispatching
+router's fencing token — the engine refuses values below its
+high-water with the typed ``EpochFencedError``, the split-brain guard
+of docs/serving.md "Router HA"), ``rid`` (caller-chosen request id for
+OP_CANCEL) and ``tenant`` (fair-share accounting at the router tier;
+replicas ignore it).
 
 SUBMIT blocks the *connection* until the request finishes — per-request
 streaming rides OP_STREAM (or stays in-process via
@@ -45,9 +68,11 @@ finished.
 
 from __future__ import annotations
 
+import collections
 import json
 import socketserver
 import threading
+import time
 from typing import List, Optional
 
 import numpy as np
@@ -60,11 +85,12 @@ from ..engine.wire import hard_reset
 from .engine import Request, ServingEngine
 from .scheduler import AdmissionError
 
-OP_SUBMIT, OP_STATS, OP_PING, OP_STREAM = range(4)
+OP_SUBMIT, OP_STATS, OP_PING, OP_STREAM, OP_CANCEL, OP_JOURNAL = range(6)
 
 __all__ = ["ServeClient", "ServeFrontend", "RemoteServeClient",
-           "ServeConnectionError", "serve", "serve_from_env",
-           "OP_SUBMIT", "OP_STATS", "OP_PING", "OP_STREAM"]
+           "ServeConnectionError", "ServeReplyError", "serve",
+           "serve_from_env", "OP_SUBMIT", "OP_STATS", "OP_PING",
+           "OP_STREAM", "OP_CANCEL", "OP_JOURNAL"]
 
 
 class ServeConnectionError(ConnectionError):
@@ -73,6 +99,31 @@ class ServeConnectionError(ConnectionError):
     callers can distinguish a dead endpoint (retry elsewhere / fail
     over) from a replica-side error reply (status=1 ``RuntimeError``,
     which would recur on retry)."""
+
+
+# status=1 error names a multi-router client may safely re-issue to
+# ANOTHER router: the refusal says "this router cannot serve you", not
+# "your request is wrong".  Everything else is non-retryable by default
+# — a typed refusal that would recur (WeightsMismatchError, ValueError,
+# QueueFullError backpressure, a tier-wide ReplicaLostError) must
+# surface to the caller, never be retried as if the router were dead.
+_RETRYABLE_REPLY_NAMES = frozenset({"RouterStandbyError"})
+
+
+class ServeReplyError(RuntimeError):
+    """A status=1 reply frame: the endpoint is alive and answered with
+    a typed error.  ``name`` is the server-side error class name parsed
+    off the payload; ``retryable`` tells the multi-router failover loop
+    whether re-issuing the request to the NEXT router can possibly
+    help (a standby refusal) or the refusal would recur anywhere
+    (weights mismatch, infeasible request, tier failure) — retrying
+    those as if the router were dead would burn the deadline repeating
+    a deterministic error."""
+
+    def __init__(self, msg: str, name: str = ""):
+        self.name = name
+        self.retryable = name in _RETRYABLE_REPLY_NAMES
+        super().__init__(msg)
 
 
 class ServeClient:
@@ -127,15 +178,52 @@ def _split_resume(params: dict, arr):
     return (toks[:-k], toks[-k:]) if k > 0 else (toks, None)
 
 
+def _wire_cancel(addr: str, params: dict, timeout: Optional[float],
+                 transport_pref: Optional[str] = None) -> bool:
+    """One OP_CANCEL round-trip on a fresh short-lived connection —
+    the single wire implementation behind ``RemoteServeClient.cancel``
+    and the router's replica-side forward (which would otherwise pay a
+    second, unused connection just to construct a client)."""
+    kind, path = resolve_transport(addr, transport_pref)
+    try:
+        s = transport_connect(kind, path, addr, timeout=timeout)
+    except OSError as e:
+        raise ServeConnectionError(
+            f"serve frontend {addr} unreachable for cancel: "
+            f"{e}") from e
+    try:
+        s.sendall(_encode(OP_CANCEL, json.dumps(params), None))
+        status, _, _, payload = _decode(s)
+    except (ConnectionError, OSError, ValueError) as e:
+        raise ServeConnectionError(
+            f"serve frontend {addr} died mid-cancel: "
+            f"{e}") from e
+    finally:
+        try:
+            s.close()
+        except OSError:
+            pass
+    if status != 0:
+        msg = payload.decode()
+        raise ServeReplyError(f"serve error: {msg!r}",
+                              name=msg.split(":", 1)[0].strip())
+    return bool(json.loads(payload.decode()).get("cancelled"))
+
+
 def _parse_submit(engine: ServingEngine, name: str, arr):
     """Decode a SUBMIT/STREAM frame into an engine submit."""
     params = json.loads(name) if name else {}
     prompt, resumed = _split_resume(params, arr)
+    # the router-epoch fence rides INTO the submit so check and
+    # admission are atomic: a deposed router's dispatch must be refused
+    # typed, never admitted (the split-brain guard — docs/serving.md
+    # "Router HA")
     req = engine.submit(
         prompt, int(params.get("max_new_tokens", 16)),
         seed=int(params.get("seed", 0)),
         priority=int(params.get("priority", 0)),
-        resume_tokens=resumed)
+        resume_tokens=resumed,
+        epoch=params.get("epoch"))
     return req, params
 
 
@@ -183,16 +271,45 @@ class _ServeHandler(socketserver.BaseRequestHandler):
                 except (ConnectionError, OSError):
                     return
                 try:
-                    if op == OP_SUBMIT:
+                    if op in (OP_SUBMIT, OP_STREAM):
                         req, params = _parse_submit(engine, name, arr)
-                        toks = req.result(
-                            timeout=float(params.get("timeout", 300.0)))
-                        reply = _encode(0, str(req.id), toks)
-                    elif op == OP_STREAM:
-                        req, _ = _parse_submit(engine, name, arr)
-                        if not self._stream(engine, sock, req):
-                            return
-                        continue
+                        rid = params.get("rid")
+                        if rid and self.server.register_rid(str(rid),
+                                                            req):
+                            # an OP_CANCEL for this rid raced ahead of
+                            # the submit (tombstoned): honor it now
+                            engine.cancel(req)
+                        try:
+                            if op == OP_SUBMIT:
+                                toks = req.result(timeout=float(
+                                    params.get("timeout", 300.0)))
+                                reply = _encode(0, str(req.id), toks)
+                            else:
+                                if not self._stream(engine, sock, req):
+                                    return
+                                continue
+                        finally:
+                            if rid:
+                                self.server.unregister_rid(str(rid),
+                                                           req)
+                    elif op == OP_CANCEL:
+                        params = json.loads(name) if name else {}
+                        if "epoch" in params:
+                            # a deposed router must not cancel work the
+                            # takeover epoch re-dispatched; the fence
+                            # stays held across the cancel so a newer
+                            # epoch's re-dispatch cannot interleave
+                            # between check and cancel
+                            with engine.epoch_fence(
+                                    int(params["epoch"])):
+                                ok = self.server.cancel_rid(
+                                    str(params.get("rid", "")))
+                        else:
+                            ok = self.server.cancel_rid(
+                                str(params.get("rid", "")))
+                        reply = _encode(
+                            0, "", None,
+                            json.dumps({"cancelled": ok}).encode())
                     elif op == OP_STATS:
                         payload = json.dumps(
                             {**engine.metrics.summary(),
@@ -247,6 +364,20 @@ class ServeFrontend(socketserver.ThreadingTCPServer):
         self._conns: set = set()
         self._conns_lock = threading.Lock()
         self._killing = False
+        # OP_CANCEL bookkeeping: caller-chosen rid -> in-flight Request,
+        # plus a bounded tombstone set for cancels that raced ahead of
+        # their own submit (the registering handler then cancels
+        # immediately instead of the cancel being silently lost)
+        self._rids: dict = {}
+        self._rid_lock = threading.Lock()
+        self._rid_tombs: "collections.OrderedDict[str, None]" = \
+            collections.OrderedDict()
+        # recently-FINISHED rids (bounded): a cancel arriving after its
+        # request completed is "too late", not "too early" — without
+        # this it would be tombstoned and silently cancel the next
+        # request reusing the rid at admission
+        self._rid_done: "collections.OrderedDict[str, None]" = \
+            collections.OrderedDict()
         # colocated fast path (docs/wire.md "Transports"): advertise a
         # UDS + shm rendezvous next to the TCP port, served by the SAME
         # handler over the same engine, unless pinned to TCP
@@ -265,6 +396,54 @@ class ServeFrontend(socketserver.ThreadingTCPServer):
                     "serve frontend: local transport endpoints "
                     "unavailable (%s); serving TCP only", e)
         engine.start()
+
+    # ------------------------------------------------ OP_CANCEL registry
+
+    def register_rid(self, rid: str, req: Request) -> bool:
+        """Associate a caller-chosen request id with its in-flight
+        engine request.  Returns True when an OP_CANCEL for this rid
+        already arrived (tombstoned) — the caller must cancel the
+        request immediately."""
+        with self._rid_lock:
+            self._rids[rid] = req
+            self._rid_done.pop(rid, None)  # the rid is live again
+            tombed = rid in self._rid_tombs
+            if tombed:
+                del self._rid_tombs[rid]
+            return tombed
+
+    def unregister_rid(self, rid: str, req: Optional[Request] = None
+                       ) -> None:
+        """Drop the registration — only while it still points at
+        ``req`` (a stalled old leg finishing late must not clobber a
+        re-dispatch's newer registration of the same rid), and record
+        the rid as recently finished."""
+        with self._rid_lock:
+            if req is not None and self._rids.get(rid) is not req:
+                return
+            self._rids.pop(rid, None)
+            self._rid_done[rid] = None
+            while len(self._rid_done) > 1024:
+                self._rid_done.popitem(last=False)
+
+    def cancel_rid(self, rid: str) -> bool:
+        """Cancel the in-flight request registered under ``rid`` (the
+        engine's eager cancel: slot + non-shared paged blocks reclaimed
+        same-tick).  An unknown rid is tombstoned (bounded) so a cancel
+        racing AHEAD of its own submit still lands — unless the rid
+        recently FINISHED here, in which case the cancel is simply too
+        late (tombstoning it would cancel the next request reusing the
+        rid).  Returns whether a live request was cancelled."""
+        with self._rid_lock:
+            req = self._rids.get(rid)
+            if req is None:
+                if rid not in self._rid_done:
+                    self._rid_tombs[rid] = None
+                    while len(self._rid_tombs) > 1024:
+                        self._rid_tombs.popitem(last=False)
+                return False
+        self.engine.cancel(req)
+        return True
 
     def _track_conn(self, sock) -> None:
         with self._conns_lock:
@@ -340,16 +519,22 @@ def serve(engine: ServingEngine, port: int, host: str = "0.0.0.0",
 
 
 def _submit_frame(op: int, prompt, max_new_tokens: int, seed: int,
-                  priority: int, resume) -> bytes:
+                  priority: int, resume, extra: Optional[dict] = None
+                  ) -> bytes:
     """Encode a SUBMIT/STREAM request: the resume tokens (if any) ride
-    the tail of the token array, counted by the ``resume`` param."""
+    the tail of the token array, counted by the ``resume`` param.
+    ``extra`` carries the optional wire params (``epoch``/``rid``/
+    ``tenant``) — omitted entirely when unused, so frames stay
+    bit-identical to the pre-HA wire for plain clients."""
     resume = ([] if resume is None
               else [int(t) for t in resume])
-    params = json.dumps({"max_new_tokens": max_new_tokens, "seed": seed,
-                         "priority": priority, "resume": len(resume)})
+    p = {"max_new_tokens": max_new_tokens, "seed": seed,
+         "priority": priority, "resume": len(resume)}
+    if extra:
+        p.update({k: v for k, v in extra.items() if v is not None})
     toks = np.concatenate([np.asarray(prompt, np.int32).reshape(-1),
                            np.asarray(resume, np.int32)])
-    return _encode(op, params, toks)
+    return _encode(op, json.dumps(p), toks)
 
 
 class RemoteServeClient:
@@ -362,33 +547,105 @@ class RemoteServeClient:
     ``BYTEPS_SERVE_CLIENT_TIMEOUT_MS`` knob), and a dead or stalled
     frontend surfaces as the typed :class:`ServeConnectionError` on
     ``generate()``/``stream()`` — promptly, never an indefinite hang.
-    One in-flight ``stream()`` per client (it holds the connection)."""
+    One in-flight ``stream()`` per client (it holds the connection).
+
+    **Multi-router failover** (docs/serving.md "Router HA"): ``addr``
+    may be a comma-separated router list.  A ``ServeConnectionError``
+    mid-call (dead router) or a *retryable* typed refusal (a standby
+    router answering before takeover) rotates to the next address and
+    re-issues the request — mid-stream with ``resume=`` the tokens
+    already received, which the PR 10 resume argument makes
+    token-identical, one tier higher.  Non-retryable typed errors
+    (``ServeReplyError.retryable`` False — e.g. a
+    ``WeightsMismatchError`` surfaced through a router) propagate
+    immediately: re-issuing a deterministic refusal elsewhere would
+    only burn the deadline.  The whole failover loop is bounded by
+    ``timeout``."""
 
     def __init__(self, addr: str, timeout: Optional[float] = None,
                  transport: Optional[str] = None):
         from ..common.config import get_config
 
         cfg = get_config()
-        kind, path = resolve_transport(
-            addr, transport if transport else cfg.transport)
-        self.addr = addr
-        self.transport = kind
+        self._addrs = [a.strip() for a in str(addr).split(",")
+                       if a.strip()]
+        if not self._addrs:
+            raise ValueError("RemoteServeClient needs at least one "
+                             "address")
+        self._transport_pref = (transport if transport
+                                else cfg.transport)
         self.timeout = (timeout if timeout is not None
                         else cfg.serve_client_timeout_ms / 1e3)
-        self._sock = transport_connect(kind, path, addr,
-                                       timeout=self.timeout)
         self._lock = threading.Lock()
+        self._cur = 0
+        self._sock = None
         # set when a stream() was abandoned mid-flight: the server
         # keeps sending that stream's frames, so the connection can no
         # longer pair requests with replies — every later op would
-        # silently read the orphaned frames as its reply
+        # silently read the orphaned frames as its reply.  A
+        # single-address client stays poisoned (the historical
+        # contract); a multi-router client clears it by reconnecting.
         self._poisoned = False
+        if len(self._addrs) == 1:
+            self._connect(0)  # eager — the single-endpoint contract
+        else:
+            self._connect_any()
+
+    # ------------------------------------------------------- connections
+
+    def _connect(self, idx: int) -> None:
+        a = self._addrs[idx]
+        kind, path = resolve_transport(a, self._transport_pref)
+        self.addr = a
+        self.transport = kind
+        self._sock = transport_connect(kind, path, a,
+                                       timeout=self.timeout)
+        self._poisoned = False
+        self._cur = idx
+
+    def _connect_any(self) -> None:
+        """Connect to the first reachable address starting at the
+        current cursor (lock held or single-threaded init)."""
+        errs = []
+        for j in range(len(self._addrs)):
+            idx = (self._cur + j) % len(self._addrs)
+            try:
+                self._connect(idx)
+                return
+            except OSError as e:
+                errs.append(f"{self._addrs[idx]}: {e}")
+        raise ServeConnectionError(
+            f"no serve endpoint reachable: {'; '.join(errs)}")
+
+    def _rotate_locked(self) -> None:
+        """Drop the current connection and point the cursor at the
+        next address (lock held); the next call reconnects."""
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        self._sock = None
+        self._poisoned = False
+        self._cur = (self._cur + 1) % len(self._addrs)
 
     def _check_usable(self) -> None:
         """Call with ``self._lock`` held: the poison flag is written
         under the same lock (a check outside it could pass while the
         abandoning thread is still inside the stream's critical
-        section)."""
+        section).  A multi-router client reconnects out of a poisoned
+        or dropped connection instead of failing — the failover loop
+        owns bounding that."""
+        if (self._poisoned or self._sock is None) \
+                and len(self._addrs) > 1:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None
+            self._connect_any()
+            return
         if self._poisoned:
             raise ServeConnectionError(
                 f"client for {self.addr} abandoned an in-flight "
@@ -404,7 +661,9 @@ class RemoteServeClient:
                 f"serve frontend {self.addr} unreachable: {e}") from e
 
     def _read_frame(self):
-        """One reply frame, with wire-level death typed."""
+        """One reply frame, with wire-level death typed and status=1
+        replies raised as :class:`ServeReplyError` (its ``retryable``
+        flag drives the multi-router failover loop)."""
         try:
             status, rname, out, payload = _decode(self._sock)
         except (ConnectionError, OSError, ValueError) as e:
@@ -413,7 +672,9 @@ class RemoteServeClient:
                 f"mid-conversation ({type(e).__name__}: {e}); "
                 f"timeout={self.timeout}s") from e
         if status != 0:
-            raise RuntimeError(f"serve error: {payload.decode()!r}")
+            msg = payload.decode()
+            raise ServeReplyError(f"serve error: {msg!r}",
+                                  name=msg.split(":", 1)[0].strip())
         return rname, out, payload
 
     def _rpc(self, op: int, name: str = "", arr=None):
@@ -422,29 +683,122 @@ class RemoteServeClient:
             self._send(_encode(op, name, arr))
             return self._read_frame()
 
+    @staticmethod
+    def _extra(epoch, rid, tenant) -> Optional[dict]:
+        if epoch is None and rid is None and tenant is None:
+            return None
+        return {"epoch": epoch, "rid": rid, "tenant": tenant}
+
     def generate(self, prompt, max_new_tokens: int, *, seed: int = 0,
-                 priority: int = 0, resume=None) -> np.ndarray:
+                 priority: int = 0, resume=None, epoch=None, rid=None,
+                 tenant=None) -> np.ndarray:
         """Blocking submit -> the full token array.  Raises the typed
-        :class:`ServeConnectionError` when the frontend dies first."""
+        :class:`ServeConnectionError` when the frontend dies first
+        (after the deadline-bounded failover loop, on a multi-router
+        client)."""
+        if len(self._addrs) == 1:
+            return self._generate_once(prompt, max_new_tokens,
+                                       seed=seed, priority=priority,
+                                       resume=resume, epoch=epoch,
+                                       rid=rid, tenant=tenant)
+        deadline = time.monotonic() + self.timeout
+        while True:
+            try:
+                return self._generate_once(
+                    prompt, max_new_tokens, seed=seed,
+                    priority=priority, resume=resume, epoch=epoch,
+                    rid=rid, tenant=tenant)
+            except (ServeConnectionError, ServeReplyError) as e:
+                self._note_failover(e, deadline)
+
+    def _generate_once(self, prompt, max_new_tokens: int, *, seed, priority,
+                       resume, epoch, rid, tenant) -> np.ndarray:
         with self._lock:
             self._check_usable()
             self._send(_submit_frame(OP_SUBMIT, prompt, max_new_tokens,
-                                     seed, priority, resume))
+                                     seed, priority, resume,
+                                     self._extra(epoch, rid, tenant)))
             _, out, _ = self._read_frame()
         return np.array(out)
 
+    def _note_failover(self, e: BaseException,
+                       deadline: float) -> BaseException:
+        """One failover-loop step: propagate non-retryable typed
+        refusals, enforce the deadline, otherwise rotate to the next
+        router with a short pause (a standby needs its takeover window
+        before it can serve).  Returns the error for chaining."""
+        if isinstance(e, ServeReplyError) and not e.retryable:
+            raise e
+        with self._lock:
+            self._rotate_locked()
+        if time.monotonic() + 0.05 > deadline:
+            raise ServeConnectionError(
+                f"no serve endpoint of {self._addrs} could complete "
+                f"the request within timeout={self.timeout}s "
+                f"(last: {type(e).__name__}: {e})") from e
+        time.sleep(0.05)
+        return e
+
     def stream(self, prompt, max_new_tokens: int, *, seed: int = 0,
-               priority: int = 0, resume=None):
+               priority: int = 0, resume=None, epoch=None, rid=None,
+               tenant=None):
         """Token iterator over the OP_STREAM wire op: yields each token
         as its frame arrives (``resume`` = already-emitted tokens for a
         failover re-dispatch — only NEW tokens are streamed back).  A
         frontend death mid-stream raises :class:`ServeConnectionError`
         within ``timeout``; a replica-side typed error raises
-        ``RuntimeError`` carrying the error name.  Abandoning the
-        iterator mid-stream POISONS the client (the server keeps
+        :class:`ServeReplyError` carrying the error name.  Abandoning
+        the iterator mid-stream POISONS the client (the server keeps
         sending the orphaned stream's frames, so request/reply pairing
         is lost) — later calls raise ``ServeConnectionError`` instead
-        of silently reading wrong replies."""
+        of silently reading wrong replies (a multi-router client
+        reconnects instead).
+
+        With several router addresses the stream is failover-wrapped:
+        a dead router (or a standby's typed refusal) re-issues the
+        request to the next address with ``resume=`` the prefix already
+        received — the consumer sees ONE uninterrupted token-identical
+        sequence."""
+        if len(self._addrs) == 1:
+            return self._stream_once(prompt, max_new_tokens, seed=seed,
+                                     priority=priority, resume=resume,
+                                     epoch=epoch, rid=rid,
+                                     tenant=tenant)
+        return self._stream_failover(prompt, max_new_tokens, seed=seed,
+                                     priority=priority, resume=resume,
+                                     epoch=epoch, rid=rid,
+                                     tenant=tenant)
+
+    def _stream_failover(self, prompt, max_new_tokens: int, *, seed,
+                         priority, resume, epoch, rid, tenant):
+        emitted: List[int] = ([int(t) for t in resume]
+                              if resume is not None else [])
+        deadline = time.monotonic() + self.timeout
+        while True:
+            try:
+                for tok in self._stream_once(
+                        prompt, max_new_tokens, seed=seed,
+                        priority=priority, resume=emitted or None,
+                        epoch=epoch, rid=rid, tenant=tenant):
+                    emitted.append(int(tok))
+                    # the failover budget is timeout WITHOUT PROGRESS:
+                    # a healthy stream longer than self.timeout must
+                    # not exhaust its own HA protection, so every
+                    # token re-arms the deadline
+                    deadline = time.monotonic() + self.timeout
+                    yield int(tok)
+                return
+            except (ServeConnectionError, ServeReplyError) as e:
+                if len(emitted) >= max_new_tokens:
+                    # the endpoint died between the final token and the
+                    # terminal frame: the stream is already fully
+                    # delivered (the router tier's argument, one tier
+                    # higher)
+                    return
+                self._note_failover(e, deadline)
+
+    def _stream_once(self, prompt, max_new_tokens: int, *, seed,
+                     priority, resume, epoch, rid, tenant):
         with self._lock:
             self._check_usable()
             in_flight = False
@@ -455,7 +809,9 @@ class RemoteServeClient:
             try:
                 self._send(_submit_frame(OP_STREAM, prompt,
                                          max_new_tokens, seed,
-                                         priority, resume))
+                                         priority, resume,
+                                         self._extra(epoch, rid,
+                                                     tenant)))
                 in_flight = True
                 while True:
                     try:
@@ -474,6 +830,78 @@ class RemoteServeClient:
                 if in_flight:
                     self._poisoned = True
 
+    def cancel(self, rid: str, epoch=None) -> bool:
+        """Wire-level cancel (OP_CANCEL) of the in-flight request
+        submitted with ``rid=`` — sent on a FRESH short-lived
+        connection, because the streaming connection is busy relaying
+        the very stream being cancelled.  Through a router the cancel
+        propagates router -> replica, so the replica's slot and paged
+        KV blocks are reclaimed same-tick.  Returns whether a live
+        request was found (False usually means it already finished, or
+        the cancel was tombstoned ahead of a racing submit).
+
+        Failover-aware like every other op, deadline-bounded by
+        ``timeout``: with several router addresses every sweep tries
+        them ALL — one router's False is not authoritative (a
+        restarted or partitioned stale active answers False for a rid
+        the true active is still serving), and a sweep that only met
+        dead routers / standby refusals sleeps and retries so a cancel
+        issued inside the takeover window still lands once the standby
+        promotes (the tombstone it leaves then kills the request's own
+        failover re-submit).  Returns True the moment any router
+        cancels; False when every router answered without one; raises
+        ``ServeConnectionError`` when none ever answered within the
+        deadline.  Non-retryable typed errors propagate immediately."""
+        params = {"rid": str(rid)}
+        if epoch is not None:
+            params["epoch"] = int(epoch)
+        # snapshot WITHOUT the client lock: an in-flight stream() holds
+        # it for the stream's whole lifetime, and this cancel must not
+        # wait behind the very stream it is cancelling (_addrs is
+        # immutable after construction; _cur is a plain int read)
+        cur = self._cur
+        addrs = [self._addrs[(cur + j) % len(self._addrs)]
+                 for j in range(len(self._addrs))]
+        if len(addrs) == 1:
+            return self._cancel_once(addrs[0], params)
+        deadline = time.monotonic() + self.timeout
+        while True:
+            answered = False
+            errs = []
+            for a in addrs:
+                try:
+                    if self._cancel_once(a, params):
+                        return True
+                    answered = True
+                except ServeConnectionError as e:
+                    errs.append(str(e))
+                except ServeReplyError as e:
+                    if not e.retryable:
+                        raise
+                    errs.append(f"{a}: {e.name}")
+            if answered:
+                # an active-claiming router answered and none held the
+                # rid: authoritative — every other address was already
+                # swept this round, so retrying buys nothing
+                return False
+            if time.monotonic() + 0.05 > deadline:
+                raise ServeConnectionError(
+                    f"no serve endpoint of {addrs} accepted cancel"
+                    f"({rid!r}) within timeout={self.timeout}s: "
+                    f"{'; '.join(errs)}")
+            time.sleep(0.05)
+
+    def _cancel_once(self, addr: str, params: dict) -> bool:
+        return _wire_cancel(addr, params, self.timeout,
+                            self._transport_pref)
+
+    def journal(self, entries: list) -> dict:
+        """Router-HA journal push (OP_JOURNAL; routers only).  Returns
+        the receiver's ack — ``{"epoch": N}`` — which is how a deposed
+        active router discovers a standby took over."""
+        _, _, payload = self._rpc(OP_JOURNAL, json.dumps(entries))
+        return json.loads(payload.decode()) if payload else {}
+
     def stats(self) -> dict:
         _, _, payload = self._rpc(OP_STATS)
         return json.loads(payload.decode())
@@ -486,6 +914,8 @@ class RemoteServeClient:
             return False
 
     def close(self) -> None:
+        if self._sock is None:
+            return
         try:
             self._sock.close()
         except OSError:

@@ -54,6 +54,38 @@ is indistinguishable from a big frontend).  Robustness is the headline
     of queueing blind, and total saturation becomes backoff-then-typed
     failure, not an unbounded queue.
 
+  * **Router high availability** (docs/serving.md "Router HA").  The
+    router itself must not be the tier's single point of failure.  A
+    priority-ordered peer list (``BYTEPS_ROUTER_PEERS``) makes the
+    lowest-priority-index live router the ACTIVE one; it streams a
+    compact journal to the standbys over the serve wire
+    (``OP_JOURNAL`` — serving/journal.py): affinity-map entries,
+    replica health/fingerprint verdicts, and per-request in-flight
+    records (id, seed, params, replica, emitted-token COUNT — the
+    client holds the tokens).  Every dispatch to a replica carries a
+    monotonic **epoch**; on active death (each standby runs a
+    ``FailureDetector`` over the routers' own OP_PING) the
+    highest-priority standby assumes the journaled state — warm
+    affinity map, verified replicas, no cold re-probe storm — and
+    bumps the epoch, so replicas FENCE the deposed epoch
+    (``EpochFencedError``): a stale active that comes back is refused
+    by the very replicas it tries to reach and demotes itself (it
+    also demotes on a journal ack carrying a higher epoch).  Clients
+    hold the multi-router address list and re-issue mid-stream with
+    ``resume_tokens`` — token-identical by the resume argument, one
+    tier higher.
+
+  * **Per-tenant fair share.**  With ``tenant_weights`` configured
+    (``BYTEPS_ROUTER_TENANT_WEIGHTS``), dispatch debits a per-tenant
+    credit pool (the ``ScheduledQueue`` credit machinery) sized by
+    weight over the tier's total credits, so one tenant flooding the
+    router cannot starve another's share of in-flight capacity.
+
+  * **Wire-level cancel.**  ``OP_CANCEL`` propagates
+    client -> router -> replica: cancelling a routed request reclaims
+    the replica's slot and paged KV blocks same-tick, not when the
+    abandoned stream would have finished.
+
 Metrics land on the PR 6 registry (``router.*``): per-replica state and
 in-flight gauges, failover / redispatch / shed / retry counters, and
 the affinity hit rate.  The launcher grows a ``router`` role
@@ -67,6 +99,7 @@ import enum
 import hashlib
 import itertools
 import json
+import re
 import socketserver
 import threading
 import time
@@ -75,19 +108,23 @@ from typing import Dict, List, Optional, Sequence, Set
 import numpy as np
 
 from ..common import logging as bps_log
+from ..common.scheduler import ScheduledQueue
 from ..engine.ps_server import _decode, _encode
 from ..engine.transport import maybe_nodelay
+from ..engine.wire import hard_reset
 from ..observability.metrics import MetricsRegistry, get_registry
 from ..resilience.detector import FailureDetector
 from ..resilience.policy import RetryPolicy
 from ..resilience.router import DegradedModeRouter
-from .frontend import (OP_PING, OP_STATS, OP_STREAM, OP_SUBMIT,
-                       RemoteServeClient, ServeConnectionError,
-                       _split_resume)
+from .frontend import (OP_CANCEL, OP_JOURNAL, OP_PING, OP_STATS,
+                       OP_STREAM, OP_SUBMIT, RemoteServeClient,
+                       ServeConnectionError, ServeReplyError,
+                       _split_resume, _wire_cancel)
+from .journal import JournalSender
 
-__all__ = ["ReplicaState", "ReplicaLostError", "WeightsMismatchError",
-           "ServeRouter", "RouterFrontend", "serve_router",
-           "router_from_env"]
+__all__ = ["ReplicaState", "ReplicaLostError", "RouterStandbyError",
+           "WeightsMismatchError", "ServeRouter", "RouterFrontend",
+           "serve_router", "router_from_env"]
 
 # ------------------------------------------------------------- metric names
 REQUESTS = "router.requests"
@@ -111,6 +148,21 @@ WEIGHTS_REFUSED = "router.weights_refused"
 # labeled per-replica gauges
 REPLICA_STATE = "router.replica_state"      # 0 healthy 1 suspect 2 dead
 REPLICA_INFLIGHT = "router.replica_inflight"  # 3 draining/retired
+# --- router HA (docs/serving.md "Router HA")
+EPOCH = "router.epoch"                      # gauge: this router's epoch
+TAKEOVERS = "router.takeovers"
+# journaled in-flight records orphaned at takeover (the clients hold
+# their tokens and re-issue with resume — the honest recovery window)
+TAKEOVER_ORPHANS = "router.takeover_orphans"
+DEMOTIONS = "router.demotions"
+STANDBY_REFUSED = "router.standby_refused"
+JOURNAL_SENT = "router.journal_entries_sent"
+JOURNAL_APPLIED = "router.journal_entries_applied"
+# --- wire-level cancel propagation
+CANCELS = "router.cancels"
+CANCELLED = "router.requests_cancelled"
+# --- per-tenant fair share (labeled gauge: credits remaining)
+TENANT_CREDITS = "router.tenant_credits"
 
 
 class ReplicaState(enum.Enum):
@@ -135,6 +187,16 @@ class ReplicaLostError(RuntimeError):
         self.attempts = attempts
         self.emitted = list(emitted)
         super().__init__(msg)
+
+
+class RouterStandbyError(RuntimeError):
+    """This router is a STANDBY (or a deposed active): it holds the
+    journal but must not place traffic — only the epoch owner may
+    dispatch, or two routers would split the affinity map and the
+    in-flight bookkeeping (the exact failure HA exists to close).
+    Typed AND client-retryable (``ServeReplyError.retryable``): a
+    multi-router client rotates to the next address instead of failing
+    the request."""
 
 
 class WeightsMismatchError(RuntimeError):
@@ -204,7 +266,12 @@ class ServeRouter:
                  miss_threshold: int = 3,
                  ping_timeout: float = 1.0,
                  registry: Optional[MetricsRegistry] = None,
-                 expected_weights_fp: Optional[str] = None):
+                 expected_weights_fp: Optional[str] = None,
+                 peers: Optional[Sequence[str]] = None,
+                 self_addr: str = "",
+                 epoch_timeout: float = 0.5,
+                 tenant_weights: Optional[Dict[str, float]] = None,
+                 journal_every: int = 8):
         if not replicas:
             raise ValueError(
                 "ServeRouter needs at least one replica address "
@@ -251,6 +318,110 @@ class ServeRouter:
         self._expected_fp: Optional[str] = expected_weights_fp or None
         self._fp_pinned = bool(expected_weights_fp)
 
+        # ---- router HA (docs/serving.md "Router HA") -----------------
+        # ``peers`` is the PRIORITY-ORDERED router address list (index
+        # 0 = initially active); ``self_addr`` names this router in it.
+        # Without peers the router is a plain single active (epoch 1 —
+        # still stamped on dispatches, so replicas always fence).
+        self.peers = ([p.strip() for p in peers if p.strip()]
+                      if peers else [])
+        self.self_addr = self_addr
+        if self.peers:
+            if self_addr not in self.peers:
+                raise ValueError(
+                    f"self_addr {self_addr!r} must appear in the peer "
+                    f"list {self.peers} (BYTEPS_ROUTER_SELF names this "
+                    f"router's own entry in BYTEPS_ROUTER_PEERS)")
+            self._self_idx = self.peers.index(self_addr)
+        else:
+            self._self_idx = 0
+        self.epoch_timeout = epoch_timeout
+        self.journal_every = max(1, journal_every)
+        self._active = self._self_idx == 0
+        self.epoch = 1 if self._active else 0
+        self._journal_epoch = 0   # highest epoch seen in the journal
+        # peer index of the current epoch owner, as far as we know
+        self._active_peer: Optional[int] = (0 if self.peers else None)
+        self._promoting = False
+        self._killed = False
+        # standby-side journal state: per-request in-flight records
+        # (bounded — the takeover contract tolerates loss; clients
+        # hold the tokens)
+        self._journal_inflight: "collections.OrderedDict[str, dict]" = \
+            collections.OrderedDict()
+        # active-side live dispatch records (rid -> record) + cancel
+        # tombstones for OP_CANCELs racing their own submit
+        self._inflight: Dict[str, dict] = {}
+        self._cancel_tombs: "collections.OrderedDict[str, None]" = \
+            collections.OrderedDict()
+        # recently-FINISHED rids (bounded): a too-late cancel must not
+        # be tombstoned — the tombstone would cancel the next request
+        # reusing the rid at admission (mirrors ServeFrontend._rid_done)
+        self._rid_done: "collections.OrderedDict[str, None]" = \
+            collections.OrderedDict()
+        self._rid_seq = itertools.count()
+        self._journal: Optional[JournalSender] = None
+        if self.peers:
+            self._journal = JournalSender(
+                [p for p in self.peers if p != self_addr],
+                timeout=ping_timeout, epoch_of=lambda: self.epoch,
+                on_stale=self._demote,
+                snapshot_fn=self._journal_snapshot)
+        self._peer_detector: Optional[FailureDetector] = None
+        if len(self.peers) > 1:
+            self._peer_detector = FailureDetector(
+                len(self.peers), self._ping_peer,
+                interval=heartbeat_interval,
+                miss_threshold=miss_threshold,
+                on_down=lambda i: self._maybe_takeover())
+        self._registry.gauge(EPOCH, track="router").set(self.epoch)
+
+        # ---- per-tenant fair share -----------------------------------
+        # weight -> a ScheduledQueue credit pool sized as this tenant's
+        # share of the tier's total in-flight credits; tenants not
+        # named in the config (and untagged requests) share the
+        # "default" bucket.  Strict reservation, deliberately NOT
+        # work-conserving: a flooding tenant is bounded by its share
+        # even when others are idle (the starvation guard is the
+        # contract; docs/serving.md "Per-tenant fair share").
+        self.tenant_weights: Dict[str, float] = dict(tenant_weights or {})
+        self._tenant_pools: Dict[str, ScheduledQueue] = {}
+        if self.tenant_weights:
+            buckets = dict(self.tenant_weights)
+            buckets.setdefault("default", 1.0)
+            for t, w in buckets.items():
+                if w <= 0:
+                    raise ValueError(
+                        f"tenant weight must be > 0, got {t}={w}")
+            cap = self.credits * len(self._replicas)
+            if cap < len(buckets):
+                raise ValueError(
+                    f"tenant fair share needs at least one credit per "
+                    f"bucket: {len(buckets)} buckets (incl. 'default') "
+                    f"but the tier only has {cap} credits "
+                    f"(credits x replicas)")
+            # largest-remainder apportionment: the pools sum EXACTLY to
+            # the tier's total credits (the documented invariant —
+            # naive per-bucket rounding can over-admit past the tier
+            # cap and flatten configured ratios), then a 1-credit floor
+            # funded by the largest shares so no tenant is configured
+            # into permanent starvation
+            total_w = sum(buckets.values())
+            raw = {t: cap * w / total_w for t, w in buckets.items()}
+            share = {t: int(raw[t]) for t in buckets}
+            order = sorted(buckets, key=lambda t: raw[t] - share[t],
+                           reverse=True)
+            for t in order[:cap - sum(share.values())]:
+                share[t] += 1
+            while min(share.values()) == 0:
+                share[min(share, key=share.get)] += 1
+                share[max(share, key=share.get)] -= 1
+            for t in buckets:
+                self._tenant_pools[t] = ScheduledQueue(
+                    scheduled=True, credit_bytes=share[t],
+                    name=f"router.tenant.{t}")
+                self._gauge_tenant(t)
+
     # ------------------------------------------------------------ lifecycle
 
     def start(self) -> "ServeRouter":
@@ -266,14 +437,353 @@ class ServeRouter:
         :class:`WeightsMismatchError`: refusing to build a tier
         whose failover re-dispatch would splice tokens from different
         checkpoints.  Replicas unreachable right now are re-checked on
-        their first successful ping and at failback."""
+        their first successful ping and at failback.
+
+        A STANDBY router starts only its peer detector: replica health
+        and weights verdicts arrive through the journal, so takeover
+        needs no registration round and no cold re-probe storm."""
+        if self._peer_detector is not None:
+            self._peer_detector.start()
+        if self._journal is not None:
+            self._journal.start()
+        if not self._active:
+            return self
         for r in self._replicas:
             self._verify_replica_weights(r, raising=True)
         self._detector.start()
+        self._jpub(k="hello")
+        for r in self._replicas:
+            self._jpub_replica(r)
         return self
 
     def close(self) -> None:
         self._detector.stop()
+        if self._peer_detector is not None:
+            self._peer_detector.stop()
+        if self._journal is not None:
+            self._journal.close()
+
+    def kill(self) -> None:
+        """Crash semantics (chaos): journaling stops IMMEDIATELY —
+        in-flight "done" entries and queued state never reach the
+        standbys, exactly like a crashed process — then the detectors
+        come down.  The standby's takeover must recover the orphaned
+        records from client ``resume_tokens``, which is the honest
+        window the docs promise."""
+        self._killed = True
+        if self._journal is not None:
+            self._journal.kill()
+        self.close()
+
+    # --------------------------------------------------------- HA: journal
+
+    @property
+    def active(self) -> bool:
+        return self._active
+
+    def _jpub(self, **ent) -> None:
+        """Publish one journal entry to the standbys (active only —
+        a standby publishing would be the split brain itself)."""
+        if self._journal is None or not self._active or self._killed:
+            return
+        ent["e"] = self.epoch
+        ent["src"] = self._self_idx
+        self._journal.publish(ent)
+        self._bump(JOURNAL_SENT)
+
+    def _jpub_replica(self, r: _Replica) -> None:
+        self._jpub(**self._replica_entry(r))
+
+    def _replica_entry(self, r: _Replica) -> dict:
+        return {"k": "replica", "r": r.idx, "dead": r.dead,
+                "refused": r.refused, "verified": r.verified,
+                "draining": r.draining or r.retired,
+                "fp": self._expected_fp}
+
+    def _journal_snapshot(self) -> List[dict]:
+        """Full-state dump for a peer (re)connect: epoch hello, every
+        replica's verdict, the whole affinity map, and the live
+        in-flight records — a standby that booted late (or dropped and
+        returned) warms up to the same state incremental entries would
+        have built."""
+        if not self._active or self._killed:
+            return []
+        with self._lock:
+            ents: List[dict] = [{"k": "hello"}]
+            ents.extend(self._replica_entry(r) for r in self._replicas)
+            ents.extend({"k": "affinity", "d": d.hex(), "r": i}
+                        for d, i in self._affinity_map.items())
+            ents.extend(
+                {"k": "inflight",
+                 **{f: rec[f] for f in ("rid", "seed", "prio", "mnt",
+                                        "tenant", "r", "n")}}
+                for rec in self._inflight.values()
+                if rec.get("r") is not None)
+            for ent in ents:
+                ent["e"] = self.epoch
+                ent["src"] = self._self_idx
+        return ents
+
+    def apply_journal(self, entries: Sequence[dict]) -> Dict[str, int]:
+        """Standby side: fold a journal batch into local state.  The
+        ack carries OUR epoch — an active sender seeing a higher one
+        knows it was deposed and demotes.  Entries from an epoch lower
+        than the highest already seen are stale (a deposed active
+        still flushing its queue) and are ignored."""
+        # deposed-discovery first, OUTSIDE the state lock (_demote
+        # takes it): one pass over the batch's epochs, not per-entry
+        if self._active:
+            newest = max((int(ent.get("e", 0)) for ent in entries),
+                         default=0)
+            if newest > self.epoch:
+                # a peer owns a NEWER epoch: we are deposed
+                self._demote(newest)
+        applied = 0
+        # one lock hold for the whole batch (a reconnect snapshot can
+        # carry thousands of entries — per-entry acquire/release would
+        # churn against the dispatch path for no isolation gain)
+        with self._lock:
+            for ent in entries:
+                if self._active:
+                    continue  # stale sender; the ack will demote it
+                e = int(ent.get("e", 0))
+                if e < self._journal_epoch:
+                    continue
+                self._journal_epoch = e
+                if ent.get("src") is not None:
+                    self._active_peer = int(ent["src"])
+                k = ent.get("k")
+                if k == "affinity":
+                    d = bytes.fromhex(ent["d"])
+                    self._affinity_map[d] = int(ent["r"])
+                    self._affinity_map.move_to_end(d)
+                    while len(self._affinity_map) > self._affinity_cap:
+                        self._affinity_map.popitem(last=False)
+                elif k == "inflight":
+                    self._journal_inflight[str(ent["rid"])] = ent
+                    self._journal_inflight.move_to_end(str(ent["rid"]))
+                    while len(self._journal_inflight) > 4096:
+                        self._journal_inflight.popitem(last=False)
+                elif k == "done":
+                    self._journal_inflight.pop(str(ent["rid"]), None)
+                elif k == "replica":
+                    i = int(ent["r"])
+                    if 0 <= i < len(self._replicas):
+                        r = self._replicas[i]
+                        r.dead = bool(ent.get("dead"))
+                        r.suspect = False
+                        r.refused = bool(ent.get("refused"))
+                        r.verified = bool(ent.get("verified"))
+                        if bool(ent.get("draining")):
+                            r.draining = True
+                        if r.dead:
+                            self._degraded.mark_down(i)
+                        else:
+                            self._degraded.mark_up(i)
+                        if ent.get("fp") and not self._fp_pinned:
+                            self._expected_fp = str(ent["fp"])
+                        self._gauge_state(r)
+                # k == "hello": epoch/src bookkeeping above is the point
+                applied += 1
+        if applied:
+            self._bump(JOURNAL_APPLIED, applied)
+        return {"epoch": max(self.epoch, self._journal_epoch)}
+
+    # -------------------------------------------------- HA: role movement
+
+    def _ping_peer(self, idx: int) -> bool:
+        if idx == self._self_idx:
+            return True
+        ok = False
+        try:
+            c = RemoteServeClient(self.peers[idx],
+                                  timeout=self.ping_timeout)
+            try:
+                ok = c.ping()
+            finally:
+                c.close()
+        except (OSError, ValueError):
+            ok = False
+        if not ok and not self._active:
+            # the detector only fires on_down on the TRANSITION; an
+            # aborted takeover (grace re-ping briefly succeeded) must
+            # re-arm while the blockers stay dead
+            self._maybe_takeover()
+        return ok
+
+    def _takeover_blockers(self) -> Set[int]:
+        """Peers that must ALL be dead before this router may assume
+        the epoch: every higher-priority peer, plus the current epoch
+        owner wherever it sits — determinism: for any set of live
+        routers exactly one satisfies this.  A router DEPOSED by an
+        owner it cannot name yet (_active_peer == -1: fenced before
+        the new active's journal reconnected) must treat every other
+        peer as a blocker — it KNOWS a higher epoch lives somewhere,
+        so promoting while any peer is up risks seizing the epoch
+        from the live active it just lost to."""
+        need = set(range(self._self_idx))
+        if self._active_peer is not None and self._active_peer < 0:
+            need.update(j for j in range(len(self.peers))
+                        if j != self._self_idx)
+        elif (self._active_peer is not None
+                and self._active_peer != self._self_idx):
+            need.add(self._active_peer)
+        return need
+
+    def _maybe_takeover(self) -> None:
+        if self._active or self._peer_detector is None:
+            return
+        blockers = self._takeover_blockers()
+        if any(self._peer_detector.is_up(j) for j in blockers):
+            return
+        with self._lock:
+            if self._active or self._promoting:
+                return
+            self._promoting = True
+        threading.Thread(target=self._takeover_after_grace,
+                         daemon=True).start()
+
+    def _takeover_after_grace(self) -> None:
+        """The epoch-timeout grace window: a transiently-stalled active
+        must not trigger a takeover it would immediately fence.  After
+        the wait every blocker is re-pinged directly — only when all
+        are STILL dead does this router assume the epoch."""
+        try:
+            time.sleep(self.epoch_timeout)
+            for j in sorted(self._takeover_blockers()):
+                if self._ping_peer(j):
+                    return  # active (or a better-priority peer) lives
+            self._become_active()
+        finally:
+            with self._lock:
+                self._promoting = False
+
+    def _become_active(self) -> None:
+        with self._lock:
+            if self._active:
+                return
+            # the floor of 1 matters: a takeover epoch must be
+            # STRICTLY greater than any epoch a router can BOOT with
+            # (index 0 boots at 1).  Without it, a standby that never
+            # received a journal entry would take over at epoch 1 and
+            # a stalled-but-alive old active would never be fenced
+            # (equal epochs pass) — permanent split brain.  With the
+            # snapshot-on-connect warmup the journal epoch is normally
+            # known anyway; this closes the cold-standby window.
+            self.epoch = max(self.epoch, self._journal_epoch, 1) + 1
+            self._journal_epoch = self.epoch
+            self._active = True
+            self._active_peer = self._self_idx
+            orphans = len(self._journal_inflight)
+            self._journal_inflight.clear()
+        self._bump(TAKEOVERS)
+        if orphans:
+            self._bump(TAKEOVER_ORPHANS, orphans)
+        self._registry.gauge(EPOCH, track="router").set(self.epoch)
+        # the journaled verdicts ARE the warm state: verified replicas
+        # stay verified (no registration storm), dead ones stay out of
+        # placement until the detector — started here — re-admits them
+        self._detector.start()
+        self._jpub(k="hello")
+        for r in self._replicas:
+            self._jpub_replica(r)
+        bps_log.warning(
+            "router %s: TAKEOVER — assuming epoch %d with %d journaled "
+            "affinity group(s), %d orphaned in-flight record(s) "
+            "(clients recover them via resume_tokens)",
+            self.self_addr or self._self_idx, self.epoch,
+            len(self._affinity_map), orphans)
+
+    def _demote(self, higher_epoch: int) -> None:
+        """A higher epoch exists (journal ack, incoming journal, or a
+        replica's EpochFencedError): this router is deposed.  It keeps
+        its journal state and its detectors — it is now a standby that
+        may take over again if the whole newer chain dies."""
+        with self._lock:
+            self._journal_epoch = max(self._journal_epoch, higher_epoch)
+            if not self._active:
+                return
+            self._active = False
+            # the epoch owner is SOMEONE ELSE now, identity unknown
+            # until their journal names it (-1 sentinel, distinct from
+            # the boot-time None): leaving _active_peer at self would
+            # make our own blocker set empty and re-promote us over
+            # the live active on the next peer-down transition
+            self._active_peer = -1
+        self._bump(DEMOTIONS)
+        bps_log.warning(
+            "router %s: DEMOTED — epoch %d fenced by epoch %d; "
+            "standing by", self.self_addr or self._self_idx,
+            self.epoch, higher_epoch)
+
+    # ------------------------------------------------- HA: cancel registry
+
+    def cancel(self, rid: str) -> bool:
+        """Wire-cancel propagation (OP_CANCEL): mark the in-flight
+        record cancelled — the dispatch loop stops re-dispatching it —
+        and forward the cancel to the replica currently serving it so
+        the slot and paged KV blocks reclaim same-tick.  Unknown rids
+        are tombstoned (bounded) to absorb a cancel racing its own
+        submit.  A STANDBY refuses typed (client-retryable) instead of
+        tombstoning: it has no in-flight records, so a False here would
+        read as "already finished" while the active router's leg keeps
+        generating."""
+        rid = str(rid)
+        if not self._active:
+            self._bump(STANDBY_REFUSED)
+            raise RouterStandbyError(
+                f"router {self.self_addr or self._self_idx} is standby "
+                f"(epoch owner: peer {self._active_peer}); cancel via "
+                f"the active router")
+        with self._lock:
+            rec = self._inflight.get(rid)
+            if rec is None:
+                if rid not in self._rid_done:
+                    # too EARLY (racing its own submit): tombstone.  A
+                    # recently-finished rid is too LATE — tombstoning
+                    # it would cancel the rid's next reuse
+                    self._cancel_tombs[rid] = None
+                    while len(self._cancel_tombs) > 1024:
+                        self._cancel_tombs.popitem(last=False)
+                return False
+            rec["cancelled"] = True
+            ridx = rec.get("r")
+            addr = (self._replicas[ridx].addr
+                    if ridx is not None else None)
+        self._bump(CANCELS)
+        if addr is None:
+            # not dispatched yet: the cancelled flag drops it before
+            # any replica leg is placed
+            return True
+        try:
+            # one fresh connection (a RemoteServeClient would eagerly
+            # open a second, unused one just to be constructed)
+            _wire_cancel(addr, {"rid": rid, "epoch": self.epoch},
+                         self.ping_timeout)
+        except ServeReplyError as e:
+            if e.name == "EpochFencedError":
+                # the replica is ALIVE and refusing our epoch: a newer
+                # active owns this request now and its leg keeps
+                # driving the replica — claiming "cancelled" would lie
+                # to the client.  Demote and report failure; the client
+                # re-issues the cancel to the new active.
+                m = re.search(r"high-water (\d+)", str(e))
+                self._demote(int(m.group(1)) if m else self.epoch)
+                return False
+            bps_log.debug("router cancel: replica %s refused (%s)",
+                          addr, e)
+        except (OSError, RuntimeError) as e:
+            # replica unreachable / leg already dead: leg death
+            # reclaims the slot on its own and the cancelled record
+            # stops re-dispatch — only the eager reclaim is lost
+            bps_log.debug("router cancel: replica %s unreachable "
+                          "(%s)", addr, e)
+        return True
+
+    def _gauge_tenant(self, tenant: str) -> None:
+        self._registry.gauge(TENANT_CREDITS, track="router",
+                             tenant=tenant).set(
+            self._tenant_pools[tenant].credits)
 
     # -------------------------------------------------------------- metrics
 
@@ -317,6 +827,7 @@ class ServeRouter:
                 # deployed under
                 r.verified = True
                 r.refused = False
+                self._jpub_replica(r)
                 return True
             if fp is not None:
                 if self._expected_fp is None:
@@ -324,10 +835,12 @@ class ServeRouter:
                 if fp == self._expected_fp:
                     r.verified = True
                     r.refused = False
+                    self._jpub_replica(r)
                     return True
             first_refusal = not r.refused
             r.refused = True
             r.verified = True
+            self._jpub_replica(r)
         if first_refusal:
             self._bump(WEIGHTS_REFUSED)
         self._gauge_state(r)
@@ -390,6 +903,7 @@ class ServeRouter:
         r.verified = False
         self._degraded.mark_down(idx)
         self._gauge_state(r)
+        self._jpub_replica(r)
         bps_log.warning("router: replica %d (%s) DEAD", idx, r.addr)
 
     def _on_replica_up(self, idx: int) -> None:
@@ -404,6 +918,7 @@ class ServeRouter:
         # but refused; matching again later re-admits it)
         self._verify_replica_weights(r, raising=False)
         self._gauge_state(r)
+        self._jpub_replica(r)
         if r.refused:
             return
         bps_log.warning("router: replica %d (%s) re-admitted (failback)",
@@ -489,6 +1004,9 @@ class ServeRouter:
                     if (mapped is None
                             or not self._replicas[mapped].placeable):
                         self._affinity_map[digest] = idx
+                        # warm placements must survive a takeover:
+                        # replicate the group -> replica binding
+                        self._jpub(k="affinity", d=digest.hex(), r=idx)
                         while (len(self._affinity_map)
                                 > self._affinity_cap):
                             self._affinity_map.popitem(last=False)
@@ -511,7 +1029,8 @@ class ServeRouter:
 
     def stream(self, prompt, max_new_tokens: int, *, seed: int = 0,
                priority: int = 0, deadline: Optional[float] = None,
-               resume=None):
+               resume=None, rid: Optional[str] = None,
+               tenant: Optional[str] = None):
         """Token iterator: place the request, stream its tokens, and on
         replica death re-dispatch to a survivor with the emitted prefix
         — the consumer sees one uninterrupted, token-identical
@@ -521,7 +1040,12 @@ class ServeRouter:
         ``resume`` = tokens the CALLER already holds (a client retrying
         through the router after its own connection loss — the same
         wire contract the serve frontend speaks); they count against
-        ``max_new_tokens`` and only new tokens are yielded."""
+        ``max_new_tokens`` and only new tokens are yielded.
+
+        ``rid`` (caller-chosen, minted when absent) names the request
+        for OP_CANCEL propagation and the HA journal's in-flight
+        record; ``tenant`` debits that tenant's fair-share credit pool
+        when tenant weights are configured."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         emitted: List[int] = ([int(t) for t in resume]
                               if resume is not None else [])
@@ -530,6 +1054,12 @@ class ServeRouter:
                 f"resume carries {len(emitted)} tokens but "
                 f"max_new_tokens is {max_new_tokens} — nothing left "
                 f"to generate")
+        if not self._active:
+            self._bump(STANDBY_REFUSED)
+            raise RouterStandbyError(
+                f"router {self.self_addr or self._self_idx} is standby "
+                f"(epoch owner: peer {self._active_peer}); retry the "
+                f"active router")
         self._bump(REQUESTS)
         deadline_ts = time.monotonic() + (
             deadline if deadline is not None else self.deadline)
@@ -538,6 +1068,16 @@ class ServeRouter:
         tried: Set[int] = set()
         attempt = 0  # consecutive no-progress attempts (resets on tokens)
         stalls = 0   # consecutive no-placeable-replica waits
+        rid = str(rid) if rid else f"r{self._self_idx}.{next(self._rid_seq)}"
+        rec = {"rid": rid, "seed": int(seed), "prio": int(priority),
+               "mnt": int(max_new_tokens), "tenant": tenant,
+               "r": None, "n": len(emitted), "cancelled": False}
+        with self._lock:
+            if rid in self._cancel_tombs:
+                del self._cancel_tombs[rid]
+                rec["cancelled"] = True
+            self._rid_done.pop(rid, None)  # the rid is live again
+            self._inflight[rid] = rec
 
         def _give_up(cause: str, err=None):
             self._bump(FAILED)
@@ -560,119 +1100,232 @@ class ServeRouter:
             self._bump(RETRIES)
             self.retry.sleep(attempt + 1)
 
-        while True:
-            r = self._acquire(digest, tried)
-            if r is None:
-                # no placeable replica this round: clear the per-round
-                # exclusions and wait — states and credits change while
-                # we do.  Saturation is NOT a failed attempt: it is
-                # bounded by the request DEADLINE alone (the RetryPolicy
-                # attempt budget counts replicas actually failing, not
-                # the router waiting its turn for a credit).
-                tried.clear()
-                stalls += 1
-                delay = max(0.005, self.retry.backoff(
-                    min(stalls, self.retry.max_attempts) + 1))
-                if time.monotonic() + delay > deadline_ts:
-                    _give_up("no placeable replica within the deadline "
-                             "(all dead, draining, or at their credit "
-                             "limit)")
-                self._bump(RETRIES)
-                time.sleep(delay)
-                continue
-            stalls = 0
-            if not r.verified and not self._verify_replica_weights(
-                    r, raising=False):
-                # registration could not reach this replica and it is
-                # still unverified (or the check just refused it): an
-                # unverified replica must never see traffic — a wrong-
-                # checkpoint replica receiving a resume re-dispatch in
-                # the window before its first successful ping is the
-                # exact splice the handshake exists to prevent.  Not a
-                # failed attempt: like saturation, this round simply
-                # skips it (the deadline bounds the overall wait, and a
-                # transiently-unreachable stats endpoint is retried on
-                # the next round / ping).
-                self._release(r)
-                tried.add(r.idx)
-                continue
-            leg: Optional[RemoteServeClient] = None
-            try:
-                leg = RemoteServeClient(r.addr,
-                                        timeout=self.stream_timeout)
-                if emitted and dispatched:
-                    # a router-internal re-dispatch (mid-stream
-                    # failover) — caller-supplied resume tokens on the
-                    # FIRST leg are not one
-                    self._bump(REDISPATCHES)
-                dispatched = True
-                for tok in leg.stream(prompt, max_new_tokens, seed=seed,
-                                      priority=priority,
-                                      resume=emitted or None):
-                    emitted.append(int(tok))
-                    attempt = 0
-                    tried.clear()
-                    yield int(tok)
-                self._bump(COMPLETED)
-                return
-            except (ServeConnectionError, OSError) as e:
-                # the replica died or stalled mid-leg (connect refused,
-                # reset mid-stream, no token within stream_timeout):
-                # feed the detector and re-dispatch to a survivor with
-                # the emitted prefix
-                self._note_leg_failure(r)
-                self._bump(FAILOVERS)
-                if len(emitted) >= max_new_tokens:
-                    # the replica died BETWEEN the final token and the
-                    # terminal frame: the stream is already fully
-                    # delivered — completing it is correct, and a
-                    # re-dispatch would be infeasible (nothing left to
-                    # generate)
-                    self._bump(COMPLETED)
+        def _jpub_inflight():
+            self._jpub(k="inflight",
+                       **{f: rec[f] for f in ("rid", "seed", "prio",
+                                              "mnt", "tenant", "r",
+                                              "n")})
+
+        tname = (tenant if tenant in self._tenant_pools else "default")
+        pool = self._tenant_pools.get(tname)
+        debited = False
+        try:
+            if pool is not None:
+                # fair-share gate: ONE credit of the tenant's pool for
+                # the request's whole lifetime (held across failover
+                # re-dispatches — the pool bounds in-flight share, not
+                # attempts).  Deadline-bounded like saturation.
+                while True:
+                    if rec["cancelled"]:
+                        self._bump(CANCELLED)
+                        return
+                    left = deadline_ts - time.monotonic()
+                    if left <= 0:
+                        _give_up(
+                            f"tenant {tname!r} at its fair-share "
+                            f"in-flight limit for the whole deadline "
+                            f"(router.tenant_credits)")
+                    # CV-woken wait (credit() notifies) in short
+                    # chunks so a cancel/deadline still lands promptly
+                    if pool.debit_wait(1, min(0.05, left)):
+                        break
+                debited = True
+                self._gauge_tenant(tname)
+            while True:
+                if rec["cancelled"]:
+                    self._bump(CANCELLED)
                     return
-                tried.add(r.idx)
-                _pace(f"replica {r.idx} ({r.addr}) lost mid-request: "
-                      f"{e}", e)
-            except RuntimeError as e:
-                msg = str(e)
-                if ("QueueFullError" in msg or "AdmissionError" in msg
-                        or "BlocksExhaustedError" in msg):
-                    # typed replica-side backpressure: shed to the next
-                    # candidate instead of queueing blind behind it
-                    self._bump(SHEDS)
+                if not self._active:
+                    # deposed mid-request (epoch fence / higher-epoch
+                    # journal): the new epoch's router owns the tier —
+                    # the client fails over to it with resume
+                    self._bump(STANDBY_REFUSED)
+                    raise RouterStandbyError(
+                        f"router {self.self_addr or self._self_idx} "
+                        f"was deposed mid-request (epoch owner: peer "
+                        f"{self._active_peer}); retry the active "
+                        f"router with resume")
+                r = self._acquire(digest, tried)
+                if r is None:
+                    # no placeable replica this round: clear the
+                    # per-round exclusions and wait — states and
+                    # credits change while we do.  Saturation is NOT a
+                    # failed attempt: it is bounded by the request
+                    # DEADLINE alone (the RetryPolicy attempt budget
+                    # counts replicas actually failing, not the router
+                    # waiting its turn for a credit).
+                    tried.clear()
+                    stalls += 1
+                    delay = max(0.005, self.retry.backoff(
+                        min(stalls, self.retry.max_attempts) + 1))
+                    if time.monotonic() + delay > deadline_ts:
+                        _give_up("no placeable replica within the "
+                                 "deadline (all dead, draining, or at "
+                                 "their credit limit)")
+                    self._bump(RETRIES)
+                    time.sleep(delay)
+                    continue
+                stalls = 0
+                if not r.verified and not self._verify_replica_weights(
+                        r, raising=False):
+                    # registration could not reach this replica and it
+                    # is still unverified (or the check just refused
+                    # it): an unverified replica must never see traffic
+                    # — a wrong-checkpoint replica receiving a resume
+                    # re-dispatch in the window before its first
+                    # successful ping is the exact splice the handshake
+                    # exists to prevent.  Not a failed attempt: like
+                    # saturation, this round simply skips it (the
+                    # deadline bounds the overall wait, and a
+                    # transiently-unreachable stats endpoint is retried
+                    # on the next round / ping).
+                    self._release(r)
                     tried.add(r.idx)
-                    _pace(f"replica {r.idx} shedding load: {msg}", e)
-                elif "ValueError" in msg:
-                    # a deterministic client error (infeasible request)
-                    # recurs on every replica — propagate, don't retry
-                    self._bump(FAILED)
-                    raise
-                else:
-                    # replica-side engine failure: that engine is gone
-                    # for this request — treat like a dead replica
+                    continue
+                leg: Optional[RemoteServeClient] = None
+                try:
+                    leg = RemoteServeClient(r.addr,
+                                            timeout=self.stream_timeout)
+                    if emitted and dispatched:
+                        # a router-internal re-dispatch (mid-stream
+                        # failover) — caller-supplied resume tokens on
+                        # the FIRST leg are not one
+                        self._bump(REDISPATCHES)
+                    dispatched = True
+                    rec["r"] = r.idx
+                    rec["n"] = len(emitted)
+                    if rec["cancelled"]:
+                        # cancel() ran between the loop-top check and
+                        # the placement (it saw r=None and relied on
+                        # us): honor it BEFORE the SUBMIT ever leaves
+                        self._bump(CANCELLED)
+                        return
+                    # the journaled in-flight record: id, params,
+                    # replica, emitted COUNT (counts, not tokens — the
+                    # client holds the tokens)
+                    _jpub_inflight()
+                    for tok in leg.stream(prompt, max_new_tokens,
+                                          seed=seed, priority=priority,
+                                          resume=emitted or None,
+                                          epoch=self.epoch, rid=rid):
+                        if rec["cancelled"]:
+                            # a cancel whose replica-side forward
+                            # missed this leg (raced a re-dispatch, or
+                            # found r=None): tear the leg down — the
+                            # finally's leg.close() disconnects, and
+                            # the replica's disconnect path eager-
+                            # cancels the slot
+                            self._bump(CANCELLED)
+                            return
+                        emitted.append(int(tok))
+                        attempt = 0
+                        tried.clear()
+                        rec["n"] = len(emitted)
+                        if rec["n"] % self.journal_every == 0:
+                            _jpub_inflight()
+                        yield int(tok)
+                    if rec["cancelled"]:
+                        # the replica-side eager cancel ended the leg
+                        # with its terminal frame early
+                        self._bump(CANCELLED)
+                    else:
+                        self._bump(COMPLETED)
+                    return
+                except (ServeConnectionError, OSError) as e:
+                    # the replica died or stalled mid-leg (connect
+                    # refused, reset mid-stream, no token within
+                    # stream_timeout): feed the detector and
+                    # re-dispatch to a survivor with the emitted prefix
                     self._note_leg_failure(r)
                     self._bump(FAILOVERS)
+                    if rec["cancelled"]:
+                        self._bump(CANCELLED)
+                        return
                     if len(emitted) >= max_new_tokens:
-                        self._bump(COMPLETED)  # already fully delivered
+                        # the replica died BETWEEN the final token and
+                        # the terminal frame: the stream is already
+                        # fully delivered — completing it is correct,
+                        # and a re-dispatch would be infeasible
+                        # (nothing left to generate)
+                        self._bump(COMPLETED)
                         return
                     tried.add(r.idx)
-                    _pace(f"replica {r.idx} failed the request: {msg}",
-                          e)
-            finally:
-                if leg is not None:
-                    leg.close()
-                self._release(r)
+                    _pace(f"replica {r.idx} ({r.addr}) lost "
+                          f"mid-request: {e}", e)
+                except RuntimeError as e:
+                    msg = str(e)
+                    if "EpochFencedError" in msg:
+                        # the replica has served a NEWER epoch: a
+                        # standby took over while we thought we were
+                        # active.  Demote and bounce the request — the
+                        # client re-issues to the new active with
+                        # resume; continuing here would double-serve it
+                        m = re.search(r"high-water (\d+)", msg)
+                        self._demote(int(m.group(1)) if m
+                                     else self.epoch)
+                        self._bump(STANDBY_REFUSED)
+                        raise RouterStandbyError(
+                            f"router {self.self_addr or self._self_idx}"
+                            f" deposed: replica {r.idx} fenced epoch "
+                            f"{self.epoch}; retry the active router "
+                            f"with resume") from e
+                    if ("QueueFullError" in msg
+                            or "AdmissionError" in msg
+                            or "BlocksExhaustedError" in msg):
+                        # typed replica-side backpressure: shed to the
+                        # next candidate instead of queueing blind
+                        self._bump(SHEDS)
+                        tried.add(r.idx)
+                        _pace(f"replica {r.idx} shedding load: {msg}",
+                              e)
+                    elif "ValueError" in msg:
+                        # a deterministic client error (infeasible
+                        # request) recurs on every replica —
+                        # propagate, don't retry
+                        self._bump(FAILED)
+                        raise
+                    else:
+                        # replica-side engine failure: that engine is
+                        # gone for this request — treat like a dead
+                        # replica
+                        self._note_leg_failure(r)
+                        self._bump(FAILOVERS)
+                        if rec["cancelled"]:
+                            self._bump(CANCELLED)
+                            return
+                        if len(emitted) >= max_new_tokens:
+                            self._bump(COMPLETED)  # fully delivered
+                            return
+                        tried.add(r.idx)
+                        _pace(f"replica {r.idx} failed the request: "
+                              f"{msg}", e)
+                finally:
+                    if leg is not None:
+                        leg.close()
+                    self._release(r)
+        finally:
+            with self._lock:
+                self._inflight.pop(rid, None)
+                self._rid_done[rid] = None
+                while len(self._rid_done) > 1024:
+                    self._rid_done.popitem(last=False)
+            if debited:
+                pool.credit(1)
+                self._gauge_tenant(tname)
+            if dispatched:
+                self._jpub(k="done", rid=rid)
 
     def generate(self, prompt, max_new_tokens: int, *, seed: int = 0,
                  priority: int = 0, deadline: Optional[float] = None,
-                 resume=None) -> np.ndarray:
+                 resume=None, rid: Optional[str] = None,
+                 tenant: Optional[str] = None) -> np.ndarray:
         """Blocking dispatch -> the NEW tokens (the OP_SUBMIT analog
         of :meth:`stream`; with ``resume`` the caller already holds
         the prefix, so only the continuation comes back)."""
         return np.asarray(
             list(self.stream(prompt, max_new_tokens, seed=seed,
                              priority=priority, deadline=deadline,
-                             resume=resume)),
+                             resume=resume, rid=rid, tenant=tenant)),
             np.int32)
 
     # ----------------------------------------------------------------- drain
@@ -702,6 +1355,7 @@ class ServeRouter:
                 self._cv.wait(remaining)
             r.retired = True
         self._bump(DRAINS)
+        self._jpub_replica(r)
         bps_log.info("router: replica %d (%s) drained and retired",
                      idx, r.addr)
 
@@ -716,10 +1370,23 @@ class ServeRouter:
                      "inflight": r.inflight} for r in self._replicas]
         out: Dict[str, object] = {"replicas": reps,
                                   "affinity": self.affinity,
-                                  "credits": self.credits}
+                                  "credits": self.credits,
+                                  "role": ("active" if self._active
+                                           else "standby"),
+                                  "epoch": self.epoch,
+                                  "journal_epoch": self._journal_epoch,
+                                  "journal_inflight":
+                                      len(self._journal_inflight),
+                                  "inflight": len(self._inflight)}
+        if self._tenant_pools:
+            out["tenant_credits"] = {
+                t: q.credits for t, q in self._tenant_pools.items()}
         for name in (REQUESTS, COMPLETED, FAILED, FAILOVERS,
                      REDISPATCHES, SHEDS, RETRIES, AFFINITY_HITS,
-                     AFFINITY_MISSES, DRAINS, WEIGHTS_REFUSED):
+                     AFFINITY_MISSES, DRAINS, WEIGHTS_REFUSED,
+                     TAKEOVERS, DEMOTIONS, STANDBY_REFUSED, CANCELS,
+                     CANCELLED, JOURNAL_SENT, JOURNAL_APPLIED,
+                     TAKEOVER_ORPHANS):
             m = self._registry.get(name)
             out[name] = m.value if m is not None else 0
         return out
@@ -729,6 +1396,11 @@ class ServeRouter:
 
 
 class _RouterHandler(socketserver.BaseRequestHandler):
+    def setup(self):
+        track = getattr(self.server, "_track_conn", None)
+        if track is not None:
+            track(self.request)
+
     def handle(self):  # one connection, many requests
         router: ServeRouter = self.server.router  # type: ignore
         sock = self.request
@@ -749,7 +1421,9 @@ class _RouterHandler(socketserver.BaseRequestHandler):
                         kw = dict(
                             seed=int(params.get("seed", 0)),
                             priority=int(params.get("priority", 0)),
-                            resume=resumed)
+                            resume=resumed,
+                            rid=params.get("rid"),
+                            tenant=params.get("tenant"))
                         mnt = int(params.get("max_new_tokens", 16))
                     if op == OP_SUBMIT:
                         new = router.generate(prompt, mnt, **kw)
@@ -782,6 +1456,17 @@ class _RouterHandler(socketserver.BaseRequestHandler):
                         finally:
                             gen.close()
                         continue
+                    elif op == OP_CANCEL:
+                        params = json.loads(name) if name else {}
+                        ok = router.cancel(str(params.get("rid", "")))
+                        reply = _encode(
+                            0, "", None,
+                            json.dumps({"cancelled": ok}).encode())
+                    elif op == OP_JOURNAL:
+                        ack = router.apply_journal(
+                            json.loads(name) if name else [])
+                        reply = _encode(0, "", None,
+                                        json.dumps(ack).encode())
                     elif op == OP_STATS:
                         reply = _encode(
                             0, "", None,
@@ -805,7 +1490,9 @@ class _RouterHandler(socketserver.BaseRequestHandler):
 class RouterFrontend(socketserver.ThreadingTCPServer):
     """TCP frontend over a :class:`ServeRouter` — wire-compatible with
     ``ServeFrontend``, so existing clients point at the router
-    unchanged."""
+    unchanged.  A STANDBY router serves the same port: it answers
+    PING/STATS/JOURNAL and refuses SUBMIT/STREAM with the typed,
+    client-retryable ``RouterStandbyError``."""
 
     allow_reuse_address = True
     daemon_threads = True
@@ -813,7 +1500,40 @@ class RouterFrontend(socketserver.ThreadingTCPServer):
     def __init__(self, addr, router: ServeRouter):
         super().__init__(addr, _RouterHandler)
         self.router = router
+        # live client sockets, so kill() can die like a crashed router
+        # process (sever mid-stream connections, not just stop
+        # accepting) — the ServeFrontend.kill discipline one tier up
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+        self._killing = False
         router.start()
+
+    def _track_conn(self, sock) -> None:
+        with self._conns_lock:
+            if not self._killing:
+                self._conns.add(sock)
+                self._conns = {s for s in self._conns
+                               if s.fileno() != -1}
+                return
+        hard_reset(sock)
+
+    def kill(self) -> None:
+        """Die like a crashed active router: hard-reset every live
+        client connection FIRST (mid-stream clients see ECONNRESET
+        mid-frame — what the multi-router client failover must
+        absorb), stop accepting, and take the ServeRouter down with
+        this process (its journal sender and detectors die too — a
+        crash leaves no background threads).  Chaos/test only."""
+        self._killing = True
+        # journaling stops FIRST: a crashed process never flushes its
+        # queued entries, and the takeover proof depends on that
+        self.router.kill()
+        with self._conns_lock:
+            conns, self._conns = set(self._conns), set()
+        for c in conns:
+            hard_reset(c)
+        self.shutdown()
+        self.server_close()
 
     def server_close(self):
         self.router.close()
@@ -825,9 +1545,10 @@ def serve_router(router: ServeRouter, port: int, host: str = "0.0.0.0",
     """Run the router frontend.  ``in_thread=True`` returns
     ``(server, thread)`` for tests; otherwise blocks (launcher mode)."""
     srv = RouterFrontend((host, port), router)
-    bps_log.info("byteps_tpu serve router listening on %s:%d over %d "
-                 "replica(s)", host, srv.server_address[1],
-                 len(router._replicas))
+    bps_log.info("byteps_tpu serve router (%s, epoch %d) listening on "
+                 "%s:%d over %d replica(s)",
+                 "active" if router.active else "standby", router.epoch,
+                 host, srv.server_address[1], len(router._replicas))
     from ..observability.scrape import maybe_start_metrics_server
 
     maybe_start_metrics_server(
@@ -864,6 +1585,30 @@ def router_from_env(env=None) -> int:
             "byteps_tpu.launcher: the router role needs "
             "BYTEPS_ROUTER_REPLICAS=host:port,host:port (the serve "
             "replicas to fan out over)")
+    peers = [a.strip() for a in cfg.router_peers.split(",")
+             if a.strip()]
+    if peers and not cfg.router_self:
+        raise SystemExit(
+            "byteps_tpu.launcher: BYTEPS_ROUTER_PEERS is set, so "
+            "BYTEPS_ROUTER_SELF must name this router's own entry in "
+            "it (host:port) — priority is the list order, and every "
+            "router must know its place in it")
+    tenant_weights: Dict[str, float] = {}
+    if cfg.router_tenant_weights:
+        for pair in cfg.router_tenant_weights.split(","):
+            t, _, w = pair.partition("=")
+            if not t.strip() or not w.strip():
+                raise SystemExit(
+                    f"byteps_tpu.launcher: malformed "
+                    f"BYTEPS_ROUTER_TENANT_WEIGHTS entry {pair!r} "
+                    f"(want tenant=weight,tenant=weight)")
+            try:
+                tenant_weights[t.strip()] = float(w)
+            except ValueError:
+                raise SystemExit(
+                    f"byteps_tpu.launcher: BYTEPS_ROUTER_TENANT_WEIGHTS "
+                    f"weight for {t.strip()!r} must be a number, got "
+                    f"{w.strip()!r}") from None
     router = ServeRouter(
         replicas,
         credits=cfg.router_credits,
@@ -874,6 +1619,10 @@ def router_from_env(env=None) -> int:
         heartbeat_interval=cfg.router_heartbeat_ms / 1e3,
         miss_threshold=cfg.router_miss_threshold,
         ping_timeout=cfg.heartbeat_timeout_ms / 1e3,
-        expected_weights_fp=cfg.router_weights_fp or None)
+        expected_weights_fp=cfg.router_weights_fp or None,
+        peers=peers or None,
+        self_addr=cfg.router_self,
+        epoch_timeout=cfg.router_epoch_timeout_ms / 1e3,
+        tenant_weights=tenant_weights or None)
     serve_router(router, cfg.router_port)
     return 0

@@ -27,10 +27,24 @@ Legs:
     in flight: zero client-visible errors, every request
     token-identical, and the replica retires.
 
+  * **router kill** (``--kill-router-at N`` — docs/serving.md "Router
+    HA"): 2 routers (active + journal-fed standby behind a peer list)
+    over 3 replicas, multi-router clients.  A long victim stream is
+    cut deterministically after exactly N token frames and the ACTIVE
+    router is killed at that moment (hard resets, crash semantics —
+    queued journal entries are dropped, not flushed).  The standby's
+    detector declares the active dead, it assumes the journaled state
+    at the next epoch, and every client splices token-identically via
+    resume (greedy AND seeded) or fails typed within its deadline —
+    zero hangs.  The leg ends with the epoch-fencing assert: a
+    dispatch stamped with the dead router's epoch is refused typed
+    (``EpochFencedError``) by a replica that served the new epoch.
+
 Usage:
     python scripts/router_chaos.py [--requests 12] [--temperature 0.8]
                                    [--fault-rate 0.12] [--no-kill]
                                    [--no-drain] [--seed 0]
+                                   [--kill-router-at N]
 
 Wired into CI as a ``slow``-marked pytest (tests/test_router_chaos.py)
 with a fast deterministic single-failover sibling in tier-1
@@ -281,6 +295,213 @@ def run(requests: int = 12, seed: int = 0, n_replicas: int = 3,
                     pass
 
 
+def run_router_kill(requests: int = 10, seed: int = 0,
+                    n_replicas: int = 3, temperature: float = 0.0,
+                    kill_at: int = 3, verbose: bool = True) -> dict:
+    """The ``--kill-router-at N`` leg: active-router death mid-stream
+    with a journal-fed standby and multi-router clients (see module
+    docstring)."""
+    import jax
+    import jax.numpy as jnp
+
+    from byteps_tpu.inference import generate
+    from byteps_tpu.models.transformer import (Transformer,
+                                               TransformerConfig)
+    from byteps_tpu.observability.metrics import MetricsRegistry
+    from byteps_tpu.resilience import FaultInjectingProxy
+    from byteps_tpu.resilience.policy import RetryPolicy
+    from byteps_tpu.serving import (RemoteServeClient, ServeMetrics,
+                                    ServingEngine, ServeRouter)
+    from byteps_tpu.serving import router as rt
+    from byteps_tpu.serving.frontend import OP_STREAM, serve
+    from byteps_tpu.serving.router import RouterFrontend
+
+    from byteps_tpu.engine.transport import free_port
+
+    cfg = TransformerConfig(vocab_size=61, num_layers=2, num_heads=2,
+                            d_model=32, d_ff=64, max_seq_len=96,
+                            dtype=jnp.float32)
+    model = Transformer(cfg)
+    variables = model.init(jax.random.PRNGKey(1),
+                           jnp.zeros((1, 8), jnp.int32))
+    rng = random.Random(seed)
+    jobs = []
+    for i in range(requests):
+        T, M = (8, 24) if i == 0 else (rng.randint(3, 16),
+                                       rng.randint(2, 10))
+        prompt = np.asarray(jax.random.randint(
+            jax.random.PRNGKey(2000 + i), (T,), 0, 61), np.int32)
+        jobs.append((prompt, M, 3000 + i))
+    refs = []
+    for prompt, M, s in jobs:
+        kw = ({"rng": jax.random.PRNGKey(s)} if temperature else {})
+        refs.append(list(np.asarray(generate(
+            model, variables, prompt[None], M, temperature=temperature,
+            **kw)["tokens"])[0]))
+
+    engines = [ServingEngine(model, variables, n_slots=4, max_seq=96,
+                             temperature=temperature,
+                             metrics=ServeMetrics())
+               for _ in range(n_replicas)]
+    srvs = [serve(e, 0, host="127.0.0.1", in_thread=True)[0]
+            for e in engines]
+    rep_addrs = ["127.0.0.1:%d" % s.server_address[1] for s in srvs]
+    pa, pb = free_port(), free_port()
+    peers = ["127.0.0.1:%d" % pa, "127.0.0.1:%d" % pb]
+    deadline = 60.0
+
+    def mk_router(self_addr):
+        return ServeRouter(
+            rep_addrs, affinity=True, affinity_block=16, credits=4,
+            deadline=deadline, stream_timeout=10.0,
+            heartbeat_interval=0.2, miss_threshold=2,
+            ping_timeout=1.0,
+            retry=RetryPolicy(max_attempts=8, backoff_base=0.05,
+                              backoff_mult=2.0, backoff_cap=0.5,
+                              jitter=0.2, deadline=0.0),
+            registry=MetricsRegistry(), peers=peers,
+            self_addr=self_addr, epoch_timeout=0.2)
+
+    ra, rb = mk_router(peers[0]), mk_router(peers[1])
+    fa = RouterFrontend(("127.0.0.1", pa), ra)
+    fb = RouterFrontend(("127.0.0.1", pb), rb)
+    for f in (fa, fb):
+        threading.Thread(target=f.serve_forever, daemon=True).start()
+    # the victim reaches the active router through a fault proxy so the
+    # router death is deterministic: its leg is cut after EXACTLY
+    # kill_at token frames and the active is killed at that moment
+    proxy = FaultInjectingProxy(peers[0], seed=seed,
+                                serve_stream_op=OP_STREAM)
+    outcomes = [None] * requests
+    durations = [0.0] * requests
+
+    def submit_one(i, addrs):
+        prompt, M, s = jobs[i]
+        t0 = time.monotonic()
+        cli = None
+        try:
+            cli = RemoteServeClient(addrs, timeout=deadline)
+            toks = list(cli.stream(prompt, M, seed=s))
+            outcomes[i] = "ok" if toks == refs[i] else "mismatch"
+        except Exception as e:
+            name = type(e).__name__
+            outcomes[i] = (name if name in ("ReplicaLostError",
+                                            "ServeConnectionError",
+                                            "ServeReplyError")
+                           else f"UNTYPED:{name}: {e}")
+        finally:
+            if cli is not None:
+                cli.close()
+        durations[i] = time.monotonic() - t0
+
+    threads = []
+    try:
+        # warm every engine before the timed/chaotic window
+        for a in rep_addrs:
+            w = RemoteServeClient(a, timeout=30.0)
+            list(w.stream(jobs[0][0], 2, seed=1))
+            w.close()
+        # background traffic on multi-router clients (jittered)
+        for i in range(1, requests):
+            t = threading.Thread(
+                target=submit_one,
+                args=(i, ",".join(peers)), daemon=True)
+            threads.append(t)
+            t.start()
+            time.sleep(rng.uniform(0.0, 0.03))
+        # the victim: cut after kill_at frames, kill the active there
+        proxy.script(("cut_stream", kill_at))
+        prompt, M, s = jobs[0]
+        t0 = time.monotonic()
+        toks = []
+        cli = RemoteServeClient(f"{proxy.addr},{peers[1]}",
+                                timeout=deadline)
+        for tok in cli.stream(prompt, M, seed=s):
+            toks.append(int(tok))
+            if len(toks) == kill_at:
+                if verbose:
+                    print(f"killing ACTIVE router at {kill_at} tokens",
+                          flush=True)
+                fa.kill()
+        cli.close()
+        outcomes[0] = "ok" if toks == refs[0] else "mismatch"
+        durations[0] = time.monotonic() - t0
+
+        hangs = 0
+        join_deadline = time.monotonic() + deadline + 30.0
+        for t in threads:
+            t.join(max(0.1, join_deadline - time.monotonic()))
+            hangs += int(t.is_alive())
+        tdl = time.monotonic() + 10.0
+        while not rb.active and time.monotonic() < tdl:
+            time.sleep(0.05)
+
+        # epoch fencing: a replica that served the takeover epoch must
+        # refuse a dispatch stamped with the dead router's epoch
+        fenced = 0
+        for a in rep_addrs:
+            probe = RemoteServeClient(a, timeout=5.0)
+            try:
+                probe.generate(jobs[1][0], 1, seed=1, epoch=rb.epoch)
+                try:
+                    probe.generate(jobs[1][0], 1, seed=1, epoch=ra.epoch)
+                except RuntimeError as e:
+                    if "EpochFencedError" in str(e):
+                        fenced += 1
+            finally:
+                probe.close()
+
+        st = rb.stats()
+        stats = {
+            "requests": requests,
+            "completed": sum(o == "ok" for o in outcomes),
+            "mismatches": sum(o == "mismatch" for o in outcomes),
+            "typed_failures": sum(
+                o in ("ReplicaLostError", "ServeConnectionError",
+                      "ServeReplyError") for o in outcomes),
+            "untyped_failures": sum(
+                o is not None and str(o).startswith("UNTYPED")
+                for o in outcomes),
+            "hangs": hangs,
+            "max_duration_s": max(durations),
+            "standby_active": rb.active,
+            "old_epoch": ra.epoch,
+            "new_epoch": rb.epoch,
+            "takeovers": st[rt.TAKEOVERS],
+            "fenced_replicas": fenced,
+            "journal_applied": st[rt.JOURNAL_APPLIED],
+        }
+        if verbose:
+            print(stats, flush=True)
+        # the acceptance contract (ISSUE 14): ANY single process in
+        # client -> router -> replica may die and every request still
+        # completes token-identically or fails typed within deadline —
+        # and the dead epoch can never dispatch again
+        assert stats["mismatches"] == 0, outcomes
+        assert stats["untyped_failures"] == 0, outcomes
+        assert stats["hangs"] == 0
+        assert stats["completed"] + stats["typed_failures"] == requests
+        assert outcomes[0] == "ok", outcomes[0]  # the victim spliced
+        assert stats["standby_active"] and stats["new_epoch"] > \
+            stats["old_epoch"]
+        assert stats["takeovers"] == 1
+        assert stats["fenced_replicas"] == len(rep_addrs)
+        assert stats["max_duration_s"] < deadline + 30.0
+        return stats
+    finally:
+        proxy.close()
+        try:
+            fb.kill()
+        except Exception:
+            pass
+        for s in srvs:
+            try:
+                s.shutdown()
+                s.server_close()
+            except Exception:
+                pass
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=12)
@@ -290,7 +511,20 @@ def main(argv=None) -> int:
     ap.add_argument("--fault-rate", type=float, default=0.12)
     ap.add_argument("--no-kill", action="store_true")
     ap.add_argument("--no-drain", action="store_true")
+    ap.add_argument("--kill-router-at", type=int, default=0,
+                    metavar="N",
+                    help="run the router-HA leg instead: cut the "
+                         "victim after N frames, kill the ACTIVE "
+                         "router there, and prove takeover + epoch "
+                         "fencing")
     args = ap.parse_args(argv)
+    if args.kill_router_at > 0:
+        run_router_kill(requests=args.requests, seed=args.seed,
+                        n_replicas=args.replicas,
+                        temperature=args.temperature,
+                        kill_at=args.kill_router_at)
+        print("router chaos (router kill): OK", flush=True)
+        return 0
     run(requests=args.requests, seed=args.seed,
         n_replicas=args.replicas, temperature=args.temperature,
         fault_rate=args.fault_rate, kill=not args.no_kill,

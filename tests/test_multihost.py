@@ -9,7 +9,6 @@ boundaries, not just the env translation.
 """
 
 import os
-import socket
 import subprocess
 import sys
 import textwrap
@@ -148,10 +147,7 @@ _TF_WORKER = textwrap.dedent(
 )
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+from byteps_tpu.engine.transport import free_port as _free_port
 
 
 def _run_two_workers(tmp_path, source, ok_marker):

@@ -74,3 +74,45 @@ def test_bench_router_affinity_beats_round_robin(tmp_path):
     assert row["hit_rate_affinity"] >= 0.8, row
     assert (row["prefill_tokens_affinity"]
             < row["prefill_tokens_rr"]), row
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("temperature", [0.0, 0.8])
+def test_router_chaos_kill_active_router(temperature):
+    """The router-HA chaos leg (ISSUE 14 acceptance): 2 routers + 3
+    replicas, a deterministic mid-stream kill of the ACTIVE router —
+    every request token-identical to the single-router run (greedy AND
+    seeded) or typed within deadline, zero hangs, and the dead epoch's
+    late dispatch is refused by every replica (epoch fencing)."""
+    import router_chaos
+
+    stats = router_chaos.run_router_kill(
+        requests=10, seed=0, temperature=temperature, kill_at=3,
+        verbose=False)
+    # run_router_kill() already asserts the contract; pin the headline
+    # numbers so a silent weakening cannot pass
+    assert stats["mismatches"] == 0
+    assert stats["untyped_failures"] == 0
+    assert stats["hangs"] == 0
+    assert stats["completed"] + stats["typed_failures"] == 10
+    assert stats["standby_active"] and stats["takeovers"] == 1
+    assert stats["new_epoch"] > stats["old_epoch"]
+    assert stats["fenced_replicas"] == 3
+
+
+@pytest.mark.slow
+def test_bench_router_ha_completes_across_router_kill(tmp_path):
+    """The router-HA bench row: the router-kill leg completes EVERY
+    request token-identical (availability degrades to takeover-window
+    latency, never to correctness) and exactly one takeover fired."""
+    import bench_serve
+
+    row = bench_serve.router_ha(
+        requests=10, tokens=16, slots=4,
+        out_path=str(tmp_path / "BENCH_SERVE.json"))
+    assert row["steady"]["completed"] == 10
+    assert row["steady"]["mismatches"] == 0
+    assert row["router_kill"]["completed"] == 10
+    assert row["router_kill"]["mismatches"] == 0
+    assert row["router_kill"]["takeovers"] == 1
+    assert row["completion_rate"] == 1.0

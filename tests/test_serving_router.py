@@ -736,3 +736,305 @@ def test_router_operator_pinned_fingerprint(tiny, prompts, greedy_base,
         assert router._expected_fp == "00" * 16
     finally:
         router.close()
+
+
+# ---------------------------------------------------------------- router HA
+
+
+from byteps_tpu.engine.transport import free_port as _free_port
+
+
+def test_engine_epoch_fence_monotonic(tiny):
+    """The replica-side split-brain guard: the engine records the
+    highest dispatch epoch and refuses anything lower, typed with
+    both epochs on the error."""
+    from byteps_tpu.serving import EpochFencedError
+
+    _, model, variables = tiny
+    eng = ServingEngine(model, variables, n_slots=1, max_seq=64,
+                        metrics=ServeMetrics())
+    eng.fence_epoch(3)
+    eng.fence_epoch(3)  # equal epochs always pass
+    eng.fence_epoch(5)
+    with pytest.raises(EpochFencedError) as ei:
+        eng.fence_epoch(4)
+    assert ei.value.epoch == 4 and ei.value.high_water == 5
+    assert eng.epoch_high_water == 5
+    # the dispatch path: submit(epoch=) runs the fence atomically with
+    # admission — a stale epoch is refused BEFORE anything is enqueued,
+    # a newer one is recorded by the admission itself
+    with pytest.raises(EpochFencedError):
+        eng.submit([1, 2, 3], 4, epoch=4)
+    req = eng.submit([1, 2, 3], 4, epoch=6)
+    assert eng.epoch_high_water == 6
+    eng.cancel(req)
+
+
+def test_router_ha_takeover_token_identical_and_fences(tiny, prompts,
+                                                       greedy_base):
+    """THE fast HA anchor (docs/serving.md "Router HA"): active router
+    A journals to standby B; a multi-router client streams through A;
+    A is KILLED mid-stream (hard resets, crash semantics — queued
+    journal entries are dropped, not flushed); B's peer detector
+    declares A dead, B assumes the journaled state at epoch 2, and the
+    client's failover re-issue (resume = the prefix it holds) splices
+    a token-identical stream.  A replica that served epoch 2 then
+    refuses an epoch-1 dispatch — the deposed epoch is fenced."""
+    from byteps_tpu.serving.router import RouterFrontend
+
+    _, model, variables = tiny
+    engine = ServingEngine(model, variables, n_slots=4, max_seq=64,
+                           temperature=0.0, metrics=ServeMetrics())
+    srv = serve(engine, 0, host="127.0.0.1", in_thread=True)[0]
+    rep_addr = "127.0.0.1:%d" % srv.server_address[1]
+    pa, pb = _free_port(), _free_port()
+    peers = [f"127.0.0.1:{pa}", f"127.0.0.1:{pb}"]
+
+    def mk(self_addr):
+        return ServeRouter(
+            [rep_addr], affinity=True, affinity_block=4, credits=4,
+            deadline=20.0, stream_timeout=5.0, heartbeat_interval=0.1,
+            miss_threshold=2, ping_timeout=0.5, retry=_fast_retry(),
+            registry=MetricsRegistry(), peers=peers,
+            self_addr=self_addr, epoch_timeout=0.1)
+
+    ra, rb = mk(peers[0]), mk(peers[1])
+    assert ra.active and ra.epoch == 1
+    assert not rb.active and rb.epoch == 0
+    fa = RouterFrontend(("127.0.0.1", pa), ra)
+    fb = RouterFrontend(("127.0.0.1", pb), rb)
+    for f in (fa, fb):
+        threading.Thread(target=f.serve_forever, daemon=True).start()
+    # the client reaches A through a fault proxy so the router death
+    # is DETERMINISTIC: the client leg is cut after exactly 2 token
+    # frames (and A is killed at that moment — a warm engine could
+    # otherwise stream every frame into the socket before a bare
+    # kill()'s reset lands)
+    proxy = FaultInjectingProxy(peers[0], serve_stream_op=OP_STREAM)
+    cli = RemoteServeClient(f"{proxy.addr},{peers[1]}", timeout=15.0)
+    try:
+        # a request through A replicates its affinity group + in-flight
+        # record to B over OP_JOURNAL
+        toks0 = list(cli.stream(prompts[0], M))
+        assert toks0 == list(greedy_base[0])
+        assert ra._journal is not None and ra._journal.flush(5.0)
+        assert len(rb._affinity_map) == 1
+        assert rb._journal_epoch == 1
+        assert rb._replicas[0].verified  # journaled verdict, no probe
+        # kill A mid-stream: the client must fail over to B and splice
+        proxy.script(("cut_stream", 2))
+        toks = []
+        for tok in cli.stream(prompts[1], M):
+            toks.append(int(tok))
+            if len(toks) == 2:
+                fa.kill()
+        assert toks == list(greedy_base[1])
+        deadline = time.monotonic() + 10.0
+        while not rb.active and time.monotonic() < deadline:
+            time.sleep(0.02)
+        st = rb.stats()
+        assert rb.active and rb.epoch == 2
+        assert st[rt.TAKEOVERS] == 1
+        # warm state survived: the journaled affinity map came along
+        assert len(rb._affinity_map) >= 1
+        # fencing: the dead epoch cannot dispatch to a replica that
+        # has served the takeover epoch (pinned on the wire)
+        probe = RemoteServeClient(rep_addr, timeout=5.0)
+        try:
+            with pytest.raises(RuntimeError, match="EpochFencedError"):
+                probe.generate(prompts[0], 2, epoch=1)
+            probe.generate(prompts[0], 2, epoch=rb.epoch)  # current ok
+        finally:
+            probe.close()
+        assert engine.epoch_high_water == rb.epoch
+        # steady traffic through the survivor stays token-identical
+        assert list(cli.stream(prompts[2], M)) == list(greedy_base[2])
+    finally:
+        cli.close()
+        proxy.close()
+        fb.kill()
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_router_standby_refusal_typed_and_retryable(tiny, prompts,
+                                                    greedy_base,
+                                                    replica_pair):
+    """A standby router refuses traffic with the typed
+    ``RouterStandbyError`` — and the client-side classification marks
+    exactly that name retryable, so a multi-router client rotates to
+    the active while a non-retryable refusal (deterministic error
+    through the active) propagates without burning attempts on other
+    routers."""
+    from byteps_tpu.serving import ServeReplyError
+    from byteps_tpu.serving.router import RouterFrontend
+
+    _, srvs, addrs = replica_pair
+    pa, pb = _free_port(), _free_port()
+    peers = [f"127.0.0.1:{pa}", f"127.0.0.1:{pb}"]
+    # B is a STANDBY (index 1); A's slot is a dead port, but a huge
+    # epoch_timeout keeps B from promoting during the test
+    rb = ServeRouter(addrs, credits=4, deadline=10.0,
+                     stream_timeout=5.0, heartbeat_interval=0.2,
+                     miss_threshold=2, ping_timeout=0.3,
+                     retry=_fast_retry(), registry=MetricsRegistry(),
+                     peers=peers, self_addr=peers[1],
+                     epoch_timeout=60.0)
+    fb = RouterFrontend(("127.0.0.1", pb), rb)
+    threading.Thread(target=fb.serve_forever, daemon=True).start()
+    # the ACTIVE router is a plain single router on its own port
+    ract = _router(addrs)
+    fact = RouterFrontend(("127.0.0.1", 0), ract)
+    threading.Thread(target=fact.serve_forever, daemon=True).start()
+    act_addr = "127.0.0.1:%d" % fact.server_address[1]
+    try:
+        # 1) single-address client: typed, named, retryable
+        c1 = RemoteServeClient(peers[1], timeout=5.0)
+        with pytest.raises(ServeReplyError) as ei:
+            c1.generate(prompts[0], M)
+        assert ei.value.name == "RouterStandbyError"
+        assert ei.value.retryable
+        assert rb.stats()[rt.STANDBY_REFUSED] >= 1
+        c1.close()
+        # 2) multi-router client listing the standby FIRST: rotates to
+        # the active and completes token-identically
+        c2 = RemoteServeClient(f"{peers[1]},{act_addr}", timeout=10.0)
+        np.testing.assert_array_equal(c2.generate(prompts[0], M),
+                                      greedy_base[0])
+        assert c2._cur == 1  # landed on the active
+        # 3) non-retryable refusal through the active: propagates
+        # immediately, never retried as if the router were dead
+        with pytest.raises(ServeReplyError) as ei:
+            c2.generate(prompts[0], 10_000)  # infeasible: > max_seq
+        assert not ei.value.retryable
+        assert c2._cur == 1  # no rotation happened
+        c2.close()
+        # 4) cancel is failover-aware too: a standby refuses OP_CANCEL
+        # typed (its False would read as "already finished" while the
+        # active keeps generating), and a multi-router client rotates
+        # the cancel to the active, whose answer IS authoritative
+        c3 = RemoteServeClient(peers[1], timeout=5.0)
+        with pytest.raises(ServeReplyError) as ei:
+            c3.cancel("no-such-rid")
+        assert ei.value.name == "RouterStandbyError"
+        c3.close()
+        c4 = RemoteServeClient(f"{peers[1]},{act_addr}", timeout=10.0)
+        assert c4.cancel("no-such-rid") is False  # active's tombstone
+        c4.close()
+    finally:
+        fb.kill()
+        fact.kill()
+
+
+def test_wire_cancel_reclaims_blocks_through_router(tiny, prompts):
+    """OP_CANCEL propagation client -> router -> replica: cancelling a
+    routed stream mid-flight reclaims the replica's slot and paged KV
+    blocks back to baseline (same-tick eager cancel), and a cancel
+    racing ahead of its own submit is tombstoned, not lost."""
+    from byteps_tpu.serving.router import RouterFrontend
+
+    _, model, variables = tiny
+    engine = ServingEngine(model, variables, n_slots=4, max_seq=64,
+                           temperature=0.0, paged=True, block=8,
+                           metrics=ServeMetrics())
+    srv = serve(engine, 0, host="127.0.0.1", in_thread=True)[0]
+    rep_addr = "127.0.0.1:%d" % srv.server_address[1]
+    baseline = engine.pool.block_stats()["used"]
+    router = _router([rep_addr])
+    fr = RouterFrontend(("127.0.0.1", 0), router)
+    threading.Thread(target=fr.serve_forever, daemon=True).start()
+    raddr = "127.0.0.1:%d" % fr.server_address[1]
+    cli = RemoteServeClient(raddr, timeout=10.0)
+    try:
+        toks = []
+        for tok in cli.stream(prompts[0], 40, rid="victim"):
+            toks.append(int(tok))
+            if len(toks) == 2:
+                c = RemoteServeClient(raddr, timeout=5.0)
+                assert c.cancel("victim") is True
+                c.close()
+        # the cancelled stream ended early, with the tokens it had
+        assert 2 <= len(toks) < 40
+        deadline = time.monotonic() + 5.0
+        while (engine.pool.block_stats()["used"] != baseline
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert engine.pool.block_stats()["used"] == baseline
+        st = router.stats()
+        assert st[rt.CANCELS] == 1
+        assert st[rt.CANCELLED] == 1
+        # tombstone: cancel BEFORE the submit arrives -> the stream is
+        # retired the moment it registers (zero or near-zero tokens)
+        c = RemoteServeClient(raddr, timeout=5.0)
+        assert c.cancel("early") is False
+        toks2 = list(c.stream(prompts[1], 40, rid="early"))
+        assert len(toks2) < 40
+        c.close()
+        assert engine.pool.block_stats()["used"] == baseline
+    finally:
+        cli.close()
+        fr.kill()
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_router_tenant_fair_share_two_tenants(tiny, prompts):
+    """Per-tenant fair-share credits: two equal-weight tenants at
+    ~10:1 offered load complete requests within 2x of their configured
+    1:1 weights while both are active — the flooding tenant is bounded
+    by its in-flight share, not by how many threads it throws at the
+    router (ScheduledQueue credit machinery, router.tenant_credits)."""
+    _, model, variables = tiny
+    engine = ServingEngine(model, variables, n_slots=4, max_seq=64,
+                           temperature=0.0, metrics=ServeMetrics())
+    srv = serve(engine, 0, host="127.0.0.1", in_thread=True)[0]
+    addr = "127.0.0.1:%d" % srv.server_address[1]
+    router = _router([addr], credits=6,
+                     tenant_weights={"a": 1.0, "b": 1.0})
+    # pool sizing: cap = credits * replicas = 6, split across a / b /
+    # default by largest-remainder apportionment — the pools sum to
+    # EXACTLY the tier cap, evenly here (equal weights, 6 % 3 == 0;
+    # an uneven cap would hand the remainder to the largest-remainder
+    # bucket and intentionally skew measured throughput with it)
+    assert set(router._tenant_pools) == {"a", "b", "default"}
+    shares = {t: q.credits for t, q in router._tenant_pools.items()}
+    assert shares == {"a": 2, "b": 2, "default": 2}
+    try:
+        # warm the engine outside the contended window
+        list(router.stream(prompts[0], 2, tenant="a"))
+        done = {"a": 0, "b": 0}
+        b_done = threading.Event()
+        lock = threading.Lock()
+
+        def worker(tenant, n):
+            for _ in range(n):
+                if tenant == "a" and b_done.is_set():
+                    return
+                list(router.stream(prompts[1], 3, tenant=tenant))
+                with lock:
+                    if not (tenant == "a" and b_done.is_set()):
+                        done[tenant] += 1
+
+        # tenant a floods from 10 threads; tenant b offers a trickle
+        flood = [threading.Thread(target=worker, args=("a", 50),
+                                  daemon=True) for _ in range(10)]
+        for t in flood:
+            t.start()
+        bt = threading.Thread(target=worker, args=("b", 6), daemon=True)
+        bt.start()
+        bt.join(30.0)
+        b_done.set()
+        assert not bt.is_alive(), "tenant b starved: fair share broken"
+        for t in flood:
+            t.join(30.0)
+        ratio = done["a"] / max(1, done["b"])
+        # equal weights => completed-request ratio within 2x of 1:1
+        # while both tenants were offering load
+        assert 0.5 <= ratio <= 2.0, done
+        st = router.stats()
+        # all credits returned after drain, at the apportioned shares
+        assert st["tenant_credits"] == shares
+    finally:
+        router.close()
+        srv.shutdown()
+        srv.server_close()
