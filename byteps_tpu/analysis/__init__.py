@@ -1,0 +1,36 @@
+"""Concurrency & consistency analysis (docs/analysis.md).
+
+Static passes (AST, no imports of the analyzed code):
+
+  * :mod:`.locks` — lock-discipline lints: majority-held guarded-field
+    inference + blocking-calls-under-a-lock,
+  * :mod:`.envknobs` — every ``BYTEPS_*`` env read routes through
+    ``common/config.py``; every config knob has a docs/env.md row,
+  * :mod:`.metricnames` — one metric name, one registry type; every
+    name in the docs catalog,
+  * :mod:`.protocols` — every wire ``OP_*`` has a dispatch branch, a
+    client producer, a collision-free value, and a docs mention.
+
+Runtime:
+
+  * :mod:`.runtime` — the ``BYTEPS_LOCKCHECK=1`` lock-order/deadlock
+    detector (instrumented Lock/RLock/Condition, acquisition-order
+    graph, typed :class:`~.runtime.LockOrderViolation`, hold-time
+    histograms on the metrics registry).
+
+``scripts/lint.py`` runs the static passes against the reviewed
+baseline ``.analysis-baseline.json`` and is wired as a fast tier-1
+test.
+"""
+
+from .runner import ALL_RULES, LintResult, run_all
+from .runtime import (LockOrderViolation, enabled, install,
+                      install_from_config, uninstall, violations)
+from .violations import Baseline, Violation, load_baseline
+
+__all__ = [
+    "ALL_RULES", "LintResult", "run_all",
+    "Violation", "Baseline", "load_baseline",
+    "LockOrderViolation", "install", "uninstall", "enabled",
+    "violations", "install_from_config",
+]

@@ -1,0 +1,122 @@
+"""Env-knob discipline (generalizes the PR 6 one-off docs lint).
+
+Two rules:
+
+``env-raw-read``
+    Every ``BYTEPS_*`` environment read **anywhere in the package**
+    must route through ``common/config.py`` — the typed ``Config`` is
+    the single parse point, so a knob can never be half-applied
+    because one module re-read the raw string with different
+    semantics (the drift that made ``BYTEPS_ENABLE_ASYNC`` mean two
+    things before this pass).  Flags literal ``BYTEPS_*`` keys in
+    ``os.environ.get`` / ``os.getenv`` / ``os.environ[...]`` /
+    ``environ.get`` outside the allowed modules.  Writes
+    (``os.environ[k] = v``, ``environ.update``) are launcher
+    territory and not flagged.
+
+``env-undocumented-knob``
+    Every knob ``common/config.py`` reads via its ``_env_*`` helpers
+    must have a ``BYTEPS_…`` row in ``docs/env.md`` (supersedes
+    ``tests/test_observability.py``'s regex one-off, which only saw
+    config.py and could not catch raw reads elsewhere).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Set, Tuple
+
+from .violations import Violation
+
+__all__ = ["analyze_env_source", "check_env_docs", "ALLOWED_MODULES"]
+
+# modules allowed to read BYTEPS_* raw: the parse point itself
+ALLOWED_MODULES = ("byteps_tpu/common/config.py",)
+
+_READ_FUNCS = {"get", "getenv", "pop"}
+
+
+def _literal_env_key(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) \
+            and node.value.startswith("BYTEPS_"):
+        return node.value
+    return None
+
+
+def _is_environ(node: ast.AST) -> bool:
+    """``os.environ`` or bare ``environ``."""
+    if isinstance(node, ast.Attribute) and node.attr == "environ":
+        return True
+    return isinstance(node, ast.Name) and node.id == "environ"
+
+
+def analyze_env_source(src: str, path: str) -> List[Violation]:
+    """Flag raw BYTEPS_* reads in one module (``env-raw-read``)."""
+    if path in ALLOWED_MODULES:
+        return []
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:  # pragma: no cover
+        return []
+    out: List[Violation] = []
+
+    def flag(key: str, line: int) -> None:
+        out.append(Violation(
+            "env-raw-read", path, "<module>", key,
+            f"raw read of {key!r} — route it through "
+            f"common/config.py (typed Config field + docs/env.md row)",
+            line))
+
+    for node in ast.walk(tree):
+        # os.environ.get("BYTEPS_X") / environ.get / os.getenv
+        if isinstance(node, ast.Call) and isinstance(node.func,
+                                                     ast.Attribute):
+            f = node.func
+            if f.attr in _READ_FUNCS and node.args:
+                key = _literal_env_key(node.args[0])
+                if key is None:
+                    continue
+                if _is_environ(f.value):
+                    flag(key, node.lineno)
+                elif isinstance(f.value, ast.Name) and f.value.id == "os" \
+                        and f.attr == "getenv":
+                    flag(key, node.lineno)
+        # os.environ["BYTEPS_X"] loads (writes excluded)
+        elif isinstance(node, ast.Subscript) and _is_environ(node.value) \
+                and isinstance(node.ctx, ast.Load):
+            key = _literal_env_key(node.slice)
+            if key is not None:
+                flag(key, node.lineno)
+    return out
+
+
+def config_knobs(config_src: str) -> Set[str]:
+    """Every BYTEPS_* name config.py reads via ``_env_*`` helpers (AST,
+    not regex — a renamed helper or odd formatting cannot hide a
+    knob)."""
+    tree = ast.parse(config_src)
+    knobs: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id.startswith("_env") and node.args:
+            key = _literal_env_key(node.args[0])
+            if key is not None:
+                knobs.add(key)
+    return knobs
+
+
+def check_env_docs(config_src: str, env_md: str,
+                   config_path: str = "byteps_tpu/common/config.py",
+                   ) -> List[Violation]:
+    """``env-undocumented-knob``: config knob without a docs/env.md
+    row."""
+    documented = set(re.findall(r"`(BYTEPS_[A-Z0-9_]+)`", env_md))
+    out: List[Violation] = []
+    for knob in sorted(config_knobs(config_src) - documented):
+        out.append(Violation(
+            "env-undocumented-knob", config_path, "Config.from_env",
+            knob,
+            f"{knob} is read by Config.from_env but has no "
+            f"`{knob}` row in docs/env.md"))
+    return out

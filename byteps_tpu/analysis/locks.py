@@ -1,0 +1,347 @@
+"""Lock-discipline static analysis (AST, whole package, no imports).
+
+Two rules over every class that allocates ``threading.Lock`` /
+``RLock`` / ``Condition`` attributes:
+
+``lock-unguarded-field``
+    Infers which instance attributes each lock guards by
+    **majority-held access**: an attribute written outside ``__init__``
+    whose accesses happen under one lock at least
+    ``GUARD_MAJORITY`` of the time (and at least ``GUARD_MIN_HELD``
+    times) is considered guarded by that lock; every access *outside*
+    it is flagged.  Writes and reads get distinct severities in the
+    message (a lock-free write is how the PR 10 stream-poison flag bug
+    happened; a lock-free read is usually a stale-value race).
+
+``lock-blocking-call``
+    Flags calls that can block indefinitely while **any** lock is held
+    — the defect class fixed by hand in PRs 6/10/14 (tracer writer,
+    journal snapshot under the router lock, ``cancel()`` waiting
+    behind the stream it cancels): socket send/recv/connect/accept,
+    ``future.result()``, ``thread.join()``, ``time.sleep``,
+    ``subprocess`` spawns, and ``Condition.wait`` on a *foreign*
+    condition (waiting on the condition you entered releases the lock
+    and is fine; waiting on anything else blocks while still holding
+    it).
+
+Deliberate scope limits (docs/analysis.md "Rule catalog"):
+
+  * ``with self._lock:`` blocks only — bare ``acquire()``/``release()``
+    pairs are not tracked (none survive in this tree; the runtime
+    detector still sees them).
+  * nested functions/lambdas are skipped entirely: a closure runs at an
+    unknown time under unknown locks, so neither counting its accesses
+    nor flagging them is sound.
+  * ``__init__``/``__del__`` accesses are ignored — construction
+    happens-before publication.
+  * methods whose name ends in ``_locked`` are analyzed as if every
+    class lock were held: the suffix is this repo's documented
+    caller-holds-the-lock convention (``ShardWorker.
+    _note_inflight_locked``), and the lint is what now enforces that a
+    helper named that way is only a helper — any blocking call inside
+    one still flags.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from .violations import Violation
+
+__all__ = ["analyze_locks_source", "GUARD_MAJORITY", "GUARD_MIN_HELD"]
+
+# an attribute counts as guarded by a lock when MORE than this fraction
+# of its (non-__init__) accesses hold that lock (strict majority)...
+GUARD_MAJORITY = 0.5
+# ...and the lock was actually held for at least this many of them
+# (one with-block touching everything would otherwise claim ownership
+# of attributes it merely passed by)
+GUARD_MIN_HELD = 2
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+# callee attribute names that block on the network / another thread
+_SOCKET_BLOCKING = {"send", "sendall", "sendmsg", "recv", "recv_into",
+                    "recvmsg", "recvfrom", "sendto", "accept", "connect",
+                    "create_connection"}
+_FUTURE_BLOCKING = {"result"}
+_SUBPROCESS_FUNCS = {"run", "call", "check_call", "check_output", "Popen",
+                     "communicate"}
+_INIT_METHODS = {"__init__", "__del__", "__post_init__"}
+
+
+@dataclasses.dataclass
+class _Access:
+    attr: str
+    held: frozenset  # canonical lock names held at the access
+    store: bool
+    line: int
+    method: str
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.X`` -> ``"X"``, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _is_lock_ctor(call: ast.AST) -> Optional[str]:
+    """``threading.Lock()`` / ``Lock()`` -> ctor name, else None."""
+    if not isinstance(call, ast.Call):
+        return None
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr in _LOCK_CTORS:
+        return f.attr
+    if isinstance(f, ast.Name) and f.id in _LOCK_CTORS:
+        return f.id
+    return None
+
+
+class _ClassLockSurvey(ast.NodeVisitor):
+    """Pass 1 over one class: find lock attrs and condition aliasing."""
+
+    def __init__(self):
+        # attr -> canonical lock identity it represents.  A Condition
+        # built on another lock attr shares that lock's identity:
+        # ``with self._cv:`` holds ``self._lock``.
+        self.locks: Dict[str, str] = {}
+        self.conditions: Set[str] = set()
+
+    def visit_Assign(self, node: ast.Assign):
+        ctor = _is_lock_ctor(node.value)
+        if ctor:
+            for tgt in node.targets:
+                attr = _self_attr(tgt)
+                if attr is None:
+                    continue
+                canonical = attr
+                if ctor == "Condition":
+                    self.conditions.add(attr)
+                    args = node.value.args
+                    if args:
+                        inner = _self_attr(args[0])
+                        if inner is not None:
+                            canonical = self.locks.get(inner, inner)
+                self.locks[attr] = canonical
+        self.generic_visit(node)
+
+
+class _MethodWalker:
+    """Pass 2 over one method: track held locks through with-blocks,
+    record attribute accesses and blocking calls."""
+
+    def __init__(self, cls_locks: Dict[str, str], conditions: Set[str],
+                 method: str):
+        self.locks = cls_locks
+        self.conditions = conditions
+        self.method = method
+        self.accesses: List[_Access] = []
+        # (callee description, line)
+        self.blocking: List[Tuple[str, int]] = []
+
+    # ------------------------------------------------------------- helpers
+
+    def _record_access(self, node: ast.AST, held: frozenset,
+                       store: bool) -> None:
+        attr = _self_attr(node)
+        if attr is None or attr in self.locks:
+            return
+        self.accesses.append(_Access(attr, held, store, node.lineno,
+                                     self.method))
+
+    def _classify_blocking(self, call: ast.Call,
+                           held: frozenset) -> Optional[str]:
+        f = call.func
+        # time.sleep(...) / sleep(...)
+        if isinstance(f, ast.Attribute):
+            recv, name = f.value, f.attr
+            if name == "sleep" and isinstance(recv, ast.Name) \
+                    and recv.id == "time":
+                return "time.sleep"
+            if name in _SOCKET_BLOCKING:
+                return f".{name}"
+            if name in _FUTURE_BLOCKING:
+                return f".{name}"
+            if name in _SUBPROCESS_FUNCS and isinstance(recv, ast.Name) \
+                    and recv.id in ("subprocess", "sp"):
+                return f"subprocess.{name}"
+            if name == "join" and self._is_thread_join(call):
+                return ".join"
+            if name in ("wait", "wait_for"):
+                return self._classify_wait(recv, name, held)
+        elif isinstance(f, ast.Name):
+            if f.id == "sleep":
+                return "time.sleep"
+        return None
+
+    @staticmethod
+    def _is_thread_join(call: ast.Call) -> bool:
+        """``t.join()`` / ``t.join(timeout)`` vs ``", ".join(parts)``:
+        a literal-string receiver proves str.join outright; otherwise
+        a str.join always has exactly one iterable positional arg — a
+        zero-arg join, a ``timeout=`` keyword, or a numeric positional
+        is a thread/process join."""
+        recv = call.func.value
+        if isinstance(recv, ast.Constant) and isinstance(recv.value, str):
+            return False  # ", ".join(map(str, xs)) / "".join(f() ...)
+        if isinstance(recv, ast.JoinedStr):
+            return False  # f-string receiver
+        if call.keywords:
+            return any(k.arg == "timeout" for k in call.keywords)
+        if not call.args:
+            return True
+        if len(call.args) == 1:
+            a = call.args[0]
+            if isinstance(a, ast.Constant) and isinstance(
+                    a.value, (int, float)):
+                return True
+            # name heuristics: join(timeout) / join(deadline - now)
+            if isinstance(a, ast.Name) and ("time" in a.id.lower()
+                                            or "deadline" in a.id.lower()):
+                return True
+            if isinstance(a, (ast.BinOp, ast.Call)):
+                # arithmetic / call args are timeouts, not iterables,
+                # in the remaining (non-literal-receiver) idioms
+                return True
+        return False
+
+    def _classify_wait(self, recv: ast.AST, name: str,
+                       held: frozenset) -> Optional[str]:
+        """``cv.wait()`` releases exactly the condition's own lock —
+        legal when that lock is the ONLY one held.  Waiting while a
+        *different* lock is also held (the PR 14 journal-snapshot
+        shape) blocks with that lock pinned; waiting on a foreign
+        waitable (Event, future, another object's condition) never
+        releases anything."""
+        attr = _self_attr(recv)
+        if attr is not None and attr in self.conditions:
+            canonical = self.locks.get(attr, attr)
+            if held == frozenset((canonical,)):
+                return None  # releases the only held lock: the idiom
+            if canonical in held:
+                return f".{name}-holding-other-lock"
+        return f".{name}"
+
+    # ------------------------------------------------------------- walking
+
+    def walk(self, body, held: frozenset) -> None:
+        for stmt in body:
+            self._walk_stmt(stmt, held)
+
+    def _walk_stmt(self, node: ast.AST, held: frozenset) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return  # closures run under unknown locks: out of scope
+        if isinstance(node, ast.With):
+            acquired: List[str] = []
+            for item in node.items:
+                self._walk_expr(item.context_expr, held)
+                attr = _self_attr(item.context_expr)
+                if attr is not None and attr in self.locks:
+                    acquired.append(self.locks[attr])
+            self.walk(node.body, held | frozenset(acquired))
+            return
+        # expressions inside this statement
+        for field in ast.iter_child_nodes(node):
+            if isinstance(field, ast.stmt):
+                self._walk_stmt(field, held)
+            else:
+                self._walk_expr(field, held)
+
+    def _walk_expr(self, node: ast.AST, held: frozenset) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return
+        if isinstance(node, ast.Attribute):
+            store = isinstance(node.ctx, (ast.Store, ast.Del))
+            self._record_access(node, held, store)
+        if isinstance(node, ast.Call) and held:
+            kind = self._classify_blocking(node, held)
+            if kind is not None:
+                self.blocking.append((kind, node.lineno))
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt):
+                self._walk_stmt(child, held)
+            else:
+                self._walk_expr(child, held)
+
+
+def analyze_locks_source(src: str, path: str) -> List[Violation]:
+    """Run both lock rules over one module's source."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:  # pragma: no cover - tree always parses
+        return [Violation("parse-error", path, "<module>", "syntax",
+                          f"cannot parse: {e}", getattr(e, "lineno", 0))]
+    out: List[Violation] = []
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        survey = _ClassLockSurvey()
+        survey.visit(cls)
+        if not survey.locks:
+            continue
+        accesses: List[_Access] = []
+        for meth in cls.body:
+            if not isinstance(meth, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            walker = _MethodWalker(survey.locks, survey.conditions,
+                                   meth.name)
+            entry_held = (frozenset(survey.locks.values())
+                          if meth.name.endswith("_locked")
+                          else frozenset())
+            walker.walk(meth.body, entry_held)
+            if meth.name not in _INIT_METHODS:
+                accesses.extend(walker.accesses)
+                for kind, line in walker.blocking:
+                    out.append(Violation(
+                        "lock-blocking-call", path,
+                        f"{cls.name}.{meth.name}", kind,
+                        f"blocking call {kind!r} while holding a lock "
+                        f"(line {line}) — move the blocking work "
+                        f"outside the with-block", line))
+        out.extend(_infer_unguarded(cls.name, path, accesses))
+    return out
+
+
+def _infer_unguarded(cls_name: str, path: str,
+                     accesses: List[_Access]) -> List[Violation]:
+    by_attr: Dict[str, List[_Access]] = {}
+    for a in accesses:
+        by_attr.setdefault(a.attr, []).append(a)
+    out: List[Violation] = []
+    for attr, accs in sorted(by_attr.items()):
+        if not any(a.store for a in accs):
+            continue  # never mutated post-init: immutable config
+        # candidate guard = the lock held for the most accesses
+        counts: Dict[str, int] = {}
+        for a in accs:
+            for lk in a.held:
+                counts[lk] = counts.get(lk, 0) + 1
+        if not counts:
+            continue
+        # tie-break toward the class's primary lock (the `_lock`
+        # idiom), then alphabetically — the *_locked all-locks
+        # convention must not attribute a field to a secondary lock
+        # that merely tied on count
+        guard = sorted(counts,
+                       key=lambda k: (-counts[k], k != "_lock", k))[0]
+        n_held = counts[guard]
+        if n_held < GUARD_MIN_HELD \
+                or n_held / len(accs) <= GUARD_MAJORITY:
+            continue
+        for a in accs:
+            if guard in a.held:
+                continue
+            sev = "write" if a.store else "read"
+            out.append(Violation(
+                "lock-unguarded-field", path,
+                f"{cls_name}.{a.method}", f"{attr}:{sev}",
+                f"{sev} of {attr!r} without {guard!r} "
+                f"({n_held}/{len(accs)} accesses hold it) — "
+                f"line {a.line}", a.line))
+    return out

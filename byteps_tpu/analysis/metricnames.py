@@ -1,0 +1,207 @@
+"""Metric/trace-name consistency (registry discipline).
+
+The typed registry already raises at *runtime* when one name is
+requested as two types — but only if both call sites actually execute
+in the same process, which chaos/serve/train paths rarely do.  This
+pass finds the conflict statically, plus undocumented names:
+
+``metric-type-conflict``
+    The same metric name created as two different registry types
+    anywhere in the package (``counter`` vs ``gauge`` vs
+    ``histogram``).  Names are resolved through literal first
+    arguments AND module-level string constants (``TOKENS =
+    "serve.tokens_generated"``), including cross-module constant
+    references (``sm.PREFILL_CREDITS``) — the dominant idiom here.
+
+``metric-undocumented``
+    Every resolvable metric name must appear in
+    ``docs/observability.md`` (the metric catalog) or the explicit
+    ``DYNAMIC_ALLOWLIST`` below (names with runtime-variable parts).
+    Dotted constants whose final segment is a file extension
+    (``"trace.json"``) are filenames, not metrics, and are skipped.
+
+Call sites recognized: any ``.counter(`` / ``.gauge(`` /
+``.histogram(`` call, plus ``ServeMetrics.bump(`` (a counter in
+disguise).  Dynamic first arguments (parameters, dict lookups) are
+skipped — they are covered at the definition site of the constant
+they forward.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .violations import Violation
+
+__all__ = ["collect_metric_uses", "check_metric_names",
+           "DYNAMIC_ALLOWLIST"]
+
+_KIND_OF_CALLEE = {"counter": "counter", "gauge": "gauge",
+                   "histogram": "histogram", "bump": "counter"}
+
+# names whose creation sites are dynamic f-strings or whose series are
+# intentionally free-form; each entry is a prefix
+DYNAMIC_ALLOWLIST: Tuple[str, ...] = ()
+
+_METRIC_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*\.[a-z0-9_.]+$")
+
+# dotted lowercase module constants whose FINAL segment is one of these
+# are filenames, not metric names ("trace.json", "ps-1234.sock") — the
+# declared-constant harvest must not drag them into the catalog check
+_FILE_EXT_SEGMENTS = frozenset(
+    {"json", "md", "py", "txt", "log", "csv", "yaml", "yml",
+     "sock", "shm", "so", "html"})
+
+
+def _is_metric_shaped(value: str) -> bool:
+    return (_METRIC_NAME_RE.match(value) is not None
+            and value.rsplit(".", 1)[-1] not in _FILE_EXT_SEGMENTS)
+
+
+def _module_consts(tree: ast.Module) -> Dict[str, str]:
+    """Module-level ``NAME = "str"`` assignments that look like metric
+    names."""
+    out: Dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                       ast.Constant) \
+                and isinstance(node.value.value, str):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id.isupper():
+                    out[tgt.id] = node.value.value
+    return out
+
+
+def _import_aliases(tree: ast.Module, modpath: str) -> Dict[str, str]:
+    """alias -> absolute-ish module key for ``from .. import x as y`` /
+    ``import a.b as c``.  Keys match the keys :func:`collect_metric_uses`
+    builds from file paths (dotted, package-relative)."""
+    pkg_parts = modpath[:-3].split("/")  # drop .py
+    out: Dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.ImportFrom):
+            level = node.level
+            if level:
+                base = pkg_parts[:-level] if level <= len(pkg_parts) else []
+                parts = base + (node.module.split(".") if node.module
+                                else [])
+                mod = ".".join(parts)
+            elif node.module is not None:
+                mod = node.module
+            else:  # pragma: no cover - "from import" needs a module
+                continue
+            for alias in node.names:
+                out[alias.asname or alias.name] = f"{mod}.{alias.name}"
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                out[alias.asname or alias.name] = alias.name
+    return out
+
+
+def collect_metric_uses(
+    sources: Sequence[Tuple[str, str]]
+) -> Tuple[Dict[str, Set[str]], Dict[str, Tuple[str, int]],
+           Dict[str, Tuple[str, int]]]:
+    """Scan ``(path, source)`` pairs.
+
+    Returns ``(uses, first_site, declared)`` where ``uses`` maps
+    metric name -> set of kinds, ``first_site`` maps name -> (path,
+    line) of its first use, and ``declared`` maps every metric-shaped
+    module constant to its declaration site (documentation check
+    covers declared-but-unused names too — they are the catalog's
+    source of truth; findings on them point at the declaration)."""
+    trees: Dict[str, ast.Module] = {}
+    consts_by_mod: Dict[str, Dict[str, str]] = {}
+    declared: Dict[str, Tuple[str, int]] = {}
+    for path, src in sources:
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:  # pragma: no cover
+            continue
+        trees[path] = tree
+        modkey = path[:-3].replace("/", ".")
+        consts = _module_consts(tree)
+        consts_by_mod[modkey] = consts
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Constant) \
+                    and isinstance(node.value.value, str) \
+                    and _is_metric_shaped(node.value.value) \
+                    and any(isinstance(t, ast.Name) and t.id.isupper()
+                            for t in node.targets):
+                declared.setdefault(node.value.value,
+                                    (path, node.lineno))
+
+    uses: Dict[str, Set[str]] = {}
+    first_site: Dict[str, Tuple[str, int]] = {}
+
+    for path, tree in trees.items():
+        modkey = path[:-3].replace("/", ".")
+        local = consts_by_mod.get(modkey, {})
+        aliases = _import_aliases(tree, path)
+
+        def resolve(node: ast.AST) -> Optional[str]:
+            if isinstance(node, ast.Constant) and isinstance(node.value,
+                                                             str):
+                return node.value
+            if isinstance(node, ast.Name):
+                if node.id in local:
+                    return local[node.id]
+                ref = aliases.get(node.id)
+                if ref is not None:  # from .metrics import TOKENS
+                    mod, _, name = ref.rpartition(".")
+                    return consts_by_mod.get(mod, {}).get(name)
+            if isinstance(node, ast.Attribute) and isinstance(
+                    node.value, ast.Name):
+                mod = aliases.get(node.value.id)
+                if mod is not None:  # import .metrics as sm; sm.TOKENS
+                    return consts_by_mod.get(mod, {}).get(node.attr)
+            return None
+
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            kind = _KIND_OF_CALLEE.get(node.func.attr)
+            if kind is None or not node.args:
+                continue
+            name = resolve(node.args[0])
+            if name is None or not _is_metric_shaped(name):
+                continue
+            uses.setdefault(name, set()).add(kind)
+            first_site.setdefault(name, (path, node.lineno))
+    return uses, first_site, declared
+
+
+def check_metric_names(sources: Sequence[Tuple[str, str]],
+                       observability_md: str,
+                       allowlist: Tuple[str, ...] = DYNAMIC_ALLOWLIST,
+                       ) -> List[Violation]:
+    uses, first_site, declared = collect_metric_uses(sources)
+    out: List[Violation] = []
+    for name, kinds in sorted(uses.items()):
+        path, line = first_site[name]
+        if len(kinds) > 1:
+            out.append(Violation(
+                "metric-type-conflict", path, "<module>", name,
+                f"metric {name!r} created as {sorted(kinds)} — "
+                f"one name, one type (the registry raises at runtime; "
+                f"this catches it before two processes disagree)",
+                line))
+    documented = set(re.findall(r"`([a-z][a-z0-9_]*\.[a-z0-9_.]+)`",
+                                observability_md))
+    for name in sorted(set(uses) | set(declared)):
+        if name in documented:
+            continue
+        if any(name.startswith(p) for p in allowlist):
+            continue
+        # a declared-but-unused name points at its declaration, so the
+        # finding always names a real file to fix
+        path, line = first_site.get(name) or declared[name]
+        out.append(Violation(
+            "metric-undocumented", path, "<module>", name,
+            f"metric {name!r} has no row in docs/observability.md "
+            f"(metric catalog) and is not allowlisted", line))
+    return out

@@ -1,0 +1,204 @@
+"""Wire-protocol consistency checks over the hand-maintained OP_* sets.
+
+The repo speaks three wire protocols built on the same length-prefixed
+framing (``engine/wire.py`` codec): the PS push/pull protocol
+(``engine/ps_server.py``), and the serving protocol
+(``serving/frontend.py``) which the router tier and the HA journal
+reuse on the same ports.  Each protocol's opcode roster is a
+hand-maintained ``OP_A, OP_B, ... = range(n)`` — PR 14 added
+``OP_JOURNAL``/``OP_CANCEL`` by editing three files and the docs in
+lockstep, which is exactly the kind of edit this pass now enforces:
+
+``proto-op-collision``
+    Two OP_* constants in one framing group share a numeric value.  A
+    collision is a silent misdispatch, not an error: the frame parses
+    fine and runs the wrong handler.
+
+``proto-missing-dispatch``
+    An op no server module of its group dispatches on (``op == OP_X``
+    or ``op in (...)``) — a client can emit a frame no peer answers.
+
+``proto-missing-producer``
+    An op no client module passes to a send/encode call — dead
+    protocol surface that rots unexercised.
+
+``proto-undocumented-op``
+    The op name is absent from the protocol's docs file(s).
+
+The roster lives in :data:`PROTOCOLS`; a new protocol (or a new module
+joining an existing framing group) registers here or the lint fails on
+its first opcode.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, List, Sequence, Tuple
+
+from .violations import Violation
+
+__all__ = ["ProtocolSpec", "PROTOCOLS", "check_protocols",
+           "collect_ops"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ProtocolSpec:
+    """One framing group: where its OP_* constants are declared, which
+    modules dispatch them server-side, which modules produce them
+    client-side, and which docs must mention each op."""
+
+    name: str
+    const_modules: Tuple[str, ...]
+    server_modules: Tuple[str, ...]
+    client_modules: Tuple[str, ...]
+    docs: Tuple[str, ...]
+
+
+PROTOCOLS: Tuple[ProtocolSpec, ...] = (
+    # PS push/pull: RemoteStore <-> PSServer (client and server share
+    # engine/ps_server.py; dispatch is Compare nodes, producers are
+    # call arguments, so cohabitation does not confuse the checks)
+    ProtocolSpec(
+        name="ps",
+        const_modules=("byteps_tpu/engine/ps_server.py",),
+        server_modules=("byteps_tpu/engine/ps_server.py",),
+        client_modules=("byteps_tpu/engine/ps_server.py",),
+        docs=("docs/wire.md",),
+    ),
+    # Serving protocol: clients -> serve frontend, reused verbatim by
+    # the router tier (same ports, same frames) and the HA journal op
+    ProtocolSpec(
+        name="serve",
+        const_modules=("byteps_tpu/serving/frontend.py",),
+        server_modules=("byteps_tpu/serving/frontend.py",
+                        "byteps_tpu/serving/router.py"),
+        client_modules=("byteps_tpu/serving/frontend.py",
+                        "byteps_tpu/serving/router.py",
+                        "byteps_tpu/serving/journal.py"),
+        docs=("docs/serving.md",),
+    ),
+)
+
+
+def collect_ops(src: str) -> Dict[str, int]:
+    """OP_* constants and their values from one module: handles
+    ``OP_A, OP_B = range(n)``, ``range(k, n)``, and plain int
+    assigns."""
+    tree = ast.parse(src)
+    ops: Dict[str, int] = {}
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Tuple) and isinstance(node.value,
+                                                         ast.Call) \
+                    and isinstance(node.value.func, ast.Name) \
+                    and node.value.func.id == "range":
+                args = node.value.args
+                try:
+                    start = (ast.literal_eval(args[0])
+                             if len(args) > 1 else 0)
+                except ValueError:  # pragma: no cover
+                    continue
+                for i, el in enumerate(tgt.elts):
+                    if isinstance(el, ast.Name) and \
+                            el.id.startswith("OP_"):
+                        ops[el.id] = start + i
+            elif isinstance(tgt, ast.Name) and tgt.id.startswith("OP_") \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, int):
+                ops[tgt.id] = node.value.value
+    return ops
+
+
+def _dispatched_ops(src: str) -> set:
+    """OP_* names appearing in Compare nodes (``op == OP_X``,
+    ``op in (OP_A, OP_B)``) — the server dispatch shape."""
+    tree = ast.parse(src)
+    found = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Compare):
+            continue
+        for cmp_ in list(node.comparators) + [node.left]:
+            elts = cmp_.elts if isinstance(cmp_, (ast.Tuple, ast.List,
+                                                  ast.Set)) else [cmp_]
+            for el in elts:
+                if isinstance(el, ast.Name) and el.id.startswith("OP_"):
+                    found.add(el.id)
+    return found
+
+
+def _produced_ops(src: str) -> set:
+    """OP_* names passed as a call argument (``_encode(OP_X, ...)``,
+    ``self._rpc(OP_X)``, ``_submit_part(i, OP_X, ...)``) or mapped in a
+    dict literal — the client-producer shape.  Compare nodes do NOT
+    count (that is dispatch)."""
+    tree = ast.parse(src)
+    found = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                elts = arg.elts if isinstance(arg, (ast.Tuple, ast.List,
+                                                    ast.Set)) else [arg]
+                for el in elts:
+                    if isinstance(el, ast.Name) and \
+                            el.id.startswith("OP_"):
+                        found.add(el.id)
+    return found
+
+
+def check_protocols(read_source, specs: Sequence[ProtocolSpec] = PROTOCOLS,
+                    ) -> List[Violation]:
+    """``read_source(repo_relative_path) -> str`` (injection point for
+    fixture trees in tests)."""
+    out: List[Violation] = []
+    for spec in specs:
+        ops: Dict[str, int] = {}
+        decl_path: Dict[str, str] = {}
+        for mod in spec.const_modules:
+            for name, val in collect_ops(read_source(mod)).items():
+                ops[name] = val
+                decl_path[name] = mod
+        # collisions within the framing group
+        by_val: Dict[int, List[str]] = {}
+        for name, val in ops.items():
+            by_val.setdefault(val, []).append(name)
+        for val, names in sorted(by_val.items()):
+            if len(names) > 1:
+                for name in sorted(names)[1:]:
+                    out.append(Violation(
+                        "proto-op-collision", decl_path[name],
+                        spec.name, name,
+                        f"{name}={val} collides with "
+                        f"{sorted(names)[0]}={val} in the "
+                        f"{spec.name!r} framing group — frames "
+                        f"misdispatch silently"))
+        dispatched = set()
+        for mod in spec.server_modules:
+            dispatched |= _dispatched_ops(read_source(mod))
+        produced = set()
+        for mod in spec.client_modules:
+            produced |= _produced_ops(read_source(mod))
+        docs_text = "".join(read_source(d) for d in spec.docs)
+        for name in sorted(ops):
+            if name not in dispatched:
+                out.append(Violation(
+                    "proto-missing-dispatch", decl_path[name],
+                    spec.name, name,
+                    f"{name} has no server dispatch branch in "
+                    f"{list(spec.server_modules)}"))
+            if name not in produced:
+                out.append(Violation(
+                    "proto-missing-producer", decl_path[name],
+                    spec.name, name,
+                    f"{name} has no client producer in "
+                    f"{list(spec.client_modules)}"))
+            if not re.search(rf"\b{name}\b", docs_text):
+                out.append(Violation(
+                    "proto-undocumented-op", decl_path[name],
+                    spec.name, name,
+                    f"{name} is not mentioned in "
+                    f"{list(spec.docs)}"))
+    return out

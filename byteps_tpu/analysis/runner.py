@@ -1,0 +1,102 @@
+"""Orchestration: run every static pass over the tree, apply the
+baseline.  Pure stdlib + AST — the passes import nothing from the
+analyzed code, and ``scripts/lint.py`` loads this package standalone
+(bare parent stub, never executing ``byteps_tpu/__init__``'s jax
+import) so the CLI stays at ~1 s of pure AST work and runs on jax-less hosts.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import envknobs, locks, metricnames, protocols
+from .violations import (Baseline, Violation, apply_baseline,
+                         load_baseline)
+
+__all__ = ["ALL_RULES", "run_all", "LintResult", "repo_root"]
+
+# rule id -> pass; --rule filters on the prefix before the first dash
+# group ("lock", "env", "metric", "proto")
+ALL_RULES = (
+    "lock-unguarded-field", "lock-blocking-call",
+    "env-raw-read", "env-undocumented-knob",
+    "metric-type-conflict", "metric-undocumented",
+    "proto-op-collision", "proto-missing-dispatch",
+    "proto-missing-producer", "proto-undocumented-op",
+)
+
+BASELINE_FILE = ".analysis-baseline.json"
+
+
+def repo_root() -> str:
+    """The tree this package was imported from (repo checkout)."""
+    return os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def _package_sources(root: str) -> List[Tuple[str, str]]:
+    out: List[Tuple[str, str]] = []
+    pkg = os.path.join(root, "byteps_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fn)
+            rel = os.path.relpath(full, root).replace(os.sep, "/")
+            with open(full, encoding="utf-8") as f:
+                out.append((rel, f.read()))
+    return out
+
+
+class LintResult:
+    def __init__(self, new: List[Violation], suppressed: List[Violation],
+                 stale: List[str], reasonless: List[str],
+                 all_violations: List[Violation]):
+        self.new = new
+        self.suppressed = suppressed
+        self.stale = stale
+        self.reasonless = reasonless
+        self.all_violations = all_violations
+
+    @property
+    def ok(self) -> bool:
+        return not self.new and not self.reasonless
+
+
+def run_all(root: Optional[str] = None,
+            rules: Optional[Sequence[str]] = None,
+            baseline: Optional[Baseline] = None) -> LintResult:
+    root = root or repo_root()
+    sources = _package_sources(root)
+
+    def read(rel: str) -> str:
+        with open(os.path.join(root, rel), encoding="utf-8") as f:
+            return f.read()
+
+    found: List[Violation] = []
+    for path, src in sources:
+        found.extend(locks.analyze_locks_source(src, path))
+        found.extend(envknobs.analyze_env_source(src, path))
+    found.extend(envknobs.check_env_docs(
+        read("byteps_tpu/common/config.py"), read("docs/env.md")))
+    found.extend(metricnames.check_metric_names(
+        sources, read("docs/observability.md")))
+    found.extend(protocols.check_protocols(read))
+
+    if rules:
+        keep = set(rules)
+        found = [v for v in found if v.rule in keep]
+    found.sort(key=lambda v: (v.path, v.line, v.rule, v.detail))
+
+    if baseline is None:
+        baseline = load_baseline(os.path.join(root, BASELINE_FILE))
+    new, suppressed, stale = apply_baseline(found, baseline)
+    if rules:
+        # a rule-filtered run must not report the other rules'
+        # suppressions as stale
+        prefixes = tuple(f"{r}:" for r in rules)
+        stale = [k for k in stale if k.startswith(prefixes)]
+    return LintResult(new, suppressed, stale, baseline.reasonless(),
+                      found)

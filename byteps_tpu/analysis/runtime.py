@@ -1,0 +1,515 @@
+"""Runtime lock-order / deadlock detector (``BYTEPS_LOCKCHECK=1``).
+
+:func:`install` replaces ``threading.Lock`` / ``RLock`` /
+``Condition`` with instrumented wrappers.  Every wrapper records, at
+**acquire-attempt time** (before blocking — a potential deadlock is
+reported even when the schedule happens not to deadlock this run):
+
+  * the per-thread **held-set**, and
+  * one edge ``held -> wanted`` per held lock into a process-global
+    acquisition-order graph, keyed by **allocation site**
+    (``file.py:lineno`` of the lock's construction — every instance
+    from one site is the same logical lock, which is what an ordering
+    discipline is about).
+
+A new edge that closes a cycle is reported as a typed
+:class:`LockOrderViolation` carrying *both* acquisition stacks — the
+stack now attempting ``A -> B`` and the recorded stack that first
+established ``B -> A`` — appended to :func:`violations` (never raised
+from inside ``acquire``: poisoning the victim thread would turn a
+report into a different bug).  Hold times are accumulated per site and
+exported as ``lockcheck.hold_s{lock=site}`` histograms through the
+PR 6 metrics registry by :func:`export_metrics` / :func:`report`.
+
+Used by the chaos harnesses (``scripts/chaos_smoke.py``,
+``scripts/router_chaos.py``, ``scripts/serve_smoke.py`` — flag
+``--lockcheck`` or knob ``BYTEPS_LOCKCHECK=1``): every chaos run then
+also proves deadlock-freedom of the schedule it drove.  Overhead is a
+dict lookup + list append per acquire (docs/analysis.md "Lockcheck
+overhead"); cycle DFS runs only when a *new* edge appears.
+
+``Condition.wait`` is modeled faithfully: waiting releases the
+condition's lock (held-set entry removed, hold time closed) and
+re-acquiring on wake re-records edges against whatever else the
+thread still holds — the exact shape of the PR 6/14 wait-under-a-
+foreign-lock bugs.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["LockOrderViolation", "install", "uninstall", "enabled",
+           "violations", "reset", "report", "export_metrics",
+           "install_from_config", "install_if"]
+
+_THIS_FILE = os.path.abspath(__file__)
+
+# originals captured at install() so wrappers and internal state always
+# use the real primitives (no self-instrumentation recursion)
+_orig: Dict[str, object] = {}
+_installed = False
+
+# process-global acquisition-order graph, all under _graph_lock (a real
+# lock, captured pre-patch)
+_graph_lock = threading.Lock()
+_edges: Dict[Tuple[str, str], "_EdgeInfo"] = {}
+_adj: Dict[str, set] = {}
+_violations: List["LockOrderViolation"] = []
+_seen_cycles: set = set()
+_holds: Dict[str, "_HoldStats"] = {}
+
+_tls = threading.local()
+
+
+class LockOrderViolation(RuntimeError):
+    """A lock-acquisition cycle.  ``cycle`` is the site-name path
+    ``[a, b, ..., a]``; ``this_stack`` is the acquisition stack that
+    closed the cycle; ``other_stack`` is the recorded stack of the
+    first conflicting edge (``cycle[1] -> ... `` direction);
+    ``edge_stacks`` maps every edge on the cycle to its first-seen
+    stack."""
+
+    def __init__(self, cycle: List[str], this_stack: str,
+                 other_stack: str,
+                 edge_stacks: Dict[Tuple[str, str], str]):
+        self.cycle = cycle
+        self.this_stack = this_stack
+        self.other_stack = other_stack
+        self.edge_stacks = edge_stacks
+        order = " -> ".join(cycle)
+        super().__init__(
+            f"lock-order cycle {order}\n"
+            f"--- acquisition closing the cycle "
+            f"({cycle[0]} -> {cycle[1]}):\n{this_stack}"
+            f"--- prior conflicting acquisition "
+            f"({cycle[1]} -> {cycle[2] if len(cycle) > 2 else cycle[0]})"
+            f":\n{other_stack}")
+
+
+class _EdgeInfo:
+    __slots__ = ("stack", "thread", "count")
+
+    def __init__(self, stack: str, thread: str):
+        self.stack = stack
+        self.thread = thread
+        self.count = 1
+
+
+class _HoldStats:
+    """Cheap accumulation per site; exported to registry histograms on
+    demand (observing into the registry per release would re-enter the
+    patched locks the registry itself uses)."""
+
+    __slots__ = ("count", "total", "max", "samples", "exported")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.max = 0.0
+        self.samples: List[float] = []
+        self.exported = 0  # samples already replayed by export_metrics
+
+    def note(self, dt: float) -> None:
+        self.count += 1
+        self.total += dt
+        if dt > self.max:
+            self.max = dt
+        if len(self.samples) < 1024:
+            self.samples.append(dt)
+
+
+def _held_stack() -> List:
+    st = getattr(_tls, "held", None)
+    if st is None:
+        st = _tls.held = []
+    return st
+
+
+def _caller_site() -> str:
+    """Allocation site of a lock: first frame outside this module and
+    outside threading.py (Event/queue internals attribute to *their*
+    caller, so e.g. every ``PendingRpc`` Event shares one site)."""
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if fn != _THIS_FILE and not fn.endswith("threading.py") \
+                and not fn.endswith("queue.py"):
+            break
+        f = f.f_back
+    if f is None:  # pragma: no cover
+        return "<unknown>"
+    fn = f.f_code.co_filename
+    for marker in ("byteps_tpu", "scripts", "tests"):
+        i = fn.find(os.sep + marker + os.sep)
+        if i >= 0:
+            fn = fn[i + 1:]
+            break
+    return f"{fn}:{f.f_lineno}"
+
+
+def _short_stack() -> str:
+    return "".join(traceback.format_stack(sys._getframe(3), limit=12))
+
+
+def _find_path(src: str, dst: str) -> Optional[List[str]]:
+    """DFS path src -> dst over the current adjacency (caller holds
+    ``_graph_lock``)."""
+    stack = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        if node == dst:
+            return path
+        for nxt in _adj.get(node, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+def _note_edges(wanted: str, held_names: List[str]) -> None:
+    if not held_names:
+        return
+    stack = None
+    with _graph_lock:
+        for held in held_names:
+            if held == wanted:
+                continue  # same-site reentry (two instances): not an
+                # ordering fact — an intra-site order needs instance
+                # identity this site-keyed graph deliberately drops
+            edge = (held, wanted)
+            info = _edges.get(edge)
+            if info is not None:
+                info.count += 1
+                continue
+            if stack is None:
+                stack = _short_stack()
+            _edges[edge] = _EdgeInfo(stack,
+                                     threading.current_thread().name)
+            _adj.setdefault(held, set()).add(wanted)
+            # does wanted already reach held?  then this edge closes a
+            # cycle
+            path = _find_path(wanted, held)
+            if path is not None:
+                cycle = path + [wanted]  # wanted -> ... -> held -> wanted
+                sig = frozenset(zip(cycle, cycle[1:]))
+                if sig in _seen_cycles:
+                    continue
+                _seen_cycles.add(sig)
+                other = _edges.get((path[0], path[1]))
+                edge_stacks = {}
+                for a, b in zip(cycle, cycle[1:]):
+                    e = _edges.get((a, b))
+                    if e is not None:
+                        edge_stacks[(a, b)] = e.stack
+                _violations.append(LockOrderViolation(
+                    [held, wanted] + path[1:],
+                    stack, other.stack if other else "<unknown>",
+                    edge_stacks))
+
+
+def _note_acquired(site: str) -> None:
+    _held_stack().append([site, time.perf_counter()])
+
+
+def _note_released(site: str) -> None:
+    st = _held_stack()
+    for i in range(len(st) - 1, -1, -1):
+        if st[i][0] == site:
+            _, t0 = st.pop(i)
+            dt = time.perf_counter() - t0
+            with _graph_lock:
+                hs = _holds.get(site)
+                if hs is None:
+                    hs = _holds[site] = _HoldStats()
+                hs.note(dt)
+            return
+    # released on a different thread than acquired (legal for a bare
+    # Lock): the acquirer's stale entry was already dropped or will be
+    # ignored — nothing to close here
+
+
+class _CheckedLock:
+    """Wrapper around a real Lock/RLock.  ``reentrant`` collapses
+    recursive RLock acquires to one held-set entry."""
+
+    def __init__(self, inner, site: str, reentrant: bool):
+        self._inner = inner
+        self._site = site
+        self._reentrant = reentrant
+        self._tlocal = threading.local()
+
+    # ------------------------------------------------- per-thread depth
+
+    def _depth(self) -> int:
+        return getattr(self._tlocal, "depth", 0)
+
+    def _set_depth(self, n: int) -> None:
+        self._tlocal.depth = n
+
+    # ----------------------------------------------------- lock surface
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        depth = self._depth() if self._reentrant else 0
+        if depth == 0:
+            _note_edges(self._site, [h[0] for h in _held_stack()])
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            if self._reentrant:
+                self._set_depth(depth + 1)
+            if depth == 0:
+                _note_acquired(self._site)
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        if self._reentrant:
+            depth = self._depth() - 1
+            self._set_depth(depth)
+            if depth > 0:
+                return
+        _note_released(self._site)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<CheckedLock {self._site} {self._inner!r}>"
+
+    # ---------------------------------------- Condition.wait bookkeeping
+
+    def _suspend_for_wait(self) -> int:
+        """About to block in ``Condition.wait`` (which releases this
+        lock, all recursion levels at once): close the held-set entry.
+        Returns the recursion depth to restore."""
+        depth = self._depth() if self._reentrant else 1
+        if self._reentrant:
+            self._set_depth(0)
+        _note_released(self._site)
+        return depth
+
+    def _resume_after_wait(self, depth: int) -> None:
+        """``Condition.wait`` returned (lock re-acquired): re-record
+        edges vs whatever this thread still holds, reopen the hold."""
+        _note_edges(self._site, [h[0] for h in _held_stack()])
+        if self._reentrant:
+            self._set_depth(depth)
+        _note_acquired(self._site)
+
+
+class _CheckedCondition:
+    """Condition over a checked (or raw) lock, delegating the real
+    waiting to an original ``threading.Condition`` built on the
+    *inner* primitive."""
+
+    def __init__(self, lock=None):
+        site = _caller_site()
+        if lock is None:
+            inner_lock = _orig["RLock"]()
+            self._lock = _CheckedLock(inner_lock, site, reentrant=True)
+        elif isinstance(lock, _CheckedLock):
+            self._lock = lock
+            inner_lock = lock._inner
+        else:  # a raw pre-install lock: wrap it so holds are tracked
+            self._lock = _CheckedLock(lock, site,
+                                      reentrant=not _is_plain_lock(lock))
+            inner_lock = lock
+        self._inner = _orig["Condition"](inner_lock)
+
+    # lock surface delegates to the checked wrapper (same inner object
+    # the real Condition releases/reacquires)
+    def acquire(self, *a, **kw):
+        return self._lock.acquire(*a, **kw)
+
+    def release(self) -> None:
+        self._lock.release()
+
+    def __enter__(self):
+        self._lock.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._lock.release()
+
+    def wait(self, timeout: Optional[float] = None):
+        depth = self._lock._suspend_for_wait()
+        try:
+            return self._inner.wait(timeout)
+        finally:
+            self._lock._resume_after_wait(depth)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        depth = self._lock._suspend_for_wait()
+        try:
+            return self._inner.wait_for(predicate, timeout)
+        finally:
+            self._lock._resume_after_wait(depth)
+
+    def notify(self, n: int = 1) -> None:
+        self._inner.notify(n)
+
+    def notify_all(self) -> None:
+        self._inner.notify_all()
+
+    notifyAll = notify_all
+
+
+def _is_plain_lock(obj) -> bool:
+    return "rlock" not in type(obj).__name__.lower()
+
+
+def _lock_factory():
+    return _CheckedLock(_orig["Lock"](), _caller_site(), reentrant=False)
+
+
+def _rlock_factory():
+    return _CheckedLock(_orig["RLock"](), _caller_site(), reentrant=True)
+
+
+# ------------------------------------------------------------------- API
+
+
+def install() -> None:
+    """Patch ``threading.Lock``/``RLock``/``Condition``.  Idempotent.
+    Locks created *before* install stay raw (invisible to the graph) —
+    install at process start (the chaos scripts do) for full
+    coverage."""
+    global _installed
+    if _installed:
+        return
+    _orig["Lock"] = threading.Lock
+    _orig["RLock"] = threading.RLock
+    _orig["Condition"] = threading.Condition
+    threading.Lock = _lock_factory
+    threading.RLock = _rlock_factory
+    threading.Condition = _CheckedCondition
+    _installed = True
+
+
+def uninstall() -> None:
+    """Restore the real primitives.  Existing wrappers keep working —
+    they hold real inner locks — but stop growing the graph only via
+    new locks; held-set bookkeeping on old wrappers continues
+    harmlessly."""
+    global _installed
+    if not _installed:
+        return
+    threading.Lock = _orig["Lock"]
+    threading.RLock = _orig["RLock"]
+    threading.Condition = _orig["Condition"]
+    _installed = False
+
+
+def enabled() -> bool:
+    return _installed
+
+
+def install_from_config() -> bool:
+    """Install iff the ``BYTEPS_LOCKCHECK`` knob is set (read through
+    the typed config, per the env-knob lint)."""
+    from ..common.config import get_config
+
+    if get_config().lockcheck:
+        install()
+    return _installed
+
+
+def install_if(flag: bool) -> bool:
+    """Harness entry (the chaos scripts' ``--lockcheck``): install when
+    the flag is set, else defer to the ``BYTEPS_LOCKCHECK`` knob — ONE
+    definition of the flag/knob precedence for every harness.  Returns
+    :func:`enabled`."""
+    if flag:
+        install()
+        return True
+    return install_from_config()
+
+
+def violations() -> List[LockOrderViolation]:
+    with _graph_lock:
+        return list(_violations)
+
+
+def reset() -> None:
+    """Clear the graph, violations, and hold stats (between test
+    legs).  Held-set state of live threads is per-thread and survives
+    — resetting mid-critical-section is on the caller."""
+    with _graph_lock:
+        _edges.clear()
+        _adj.clear()
+        _violations.clear()
+        _seen_cycles.clear()
+        _holds.clear()
+
+
+def export_metrics(registry=None) -> None:
+    """Replay hold-time samples accumulated SINCE THE LAST EXPORT into
+    ``lockcheck.hold_s{lock=site}`` registry histograms (the PR 6
+    scrape surface: ``/metrics``, ``OP_STATS``, STATS).  Incremental
+    so back-to-back chaos legs in one process (serve_smoke runs two
+    temperatures) don't double-count earlier holds into the
+    process-global registry; ``reset()`` rewinds the cursor with the
+    samples."""
+    from ..observability.metrics import get_registry
+
+    reg = registry if registry is not None else get_registry()
+    with _graph_lock:
+        snap = {site: list(hs.samples[hs.exported:])
+                for site, hs in _holds.items()}
+        for hs in _holds.values():
+            hs.exported = len(hs.samples)
+    buckets = (1e-5, 1e-4, 5e-4, 1e-3, 5e-3, 0.01, 0.05, 0.1, 0.5,
+               1.0, 5.0)
+    for site, samples in snap.items():
+        h = reg.histogram("lockcheck.hold_s", track="lockcheck",
+                          buckets=buckets, lock=site)
+        for s in samples:
+            h.observe(s)
+
+
+def chaos_verdict() -> Dict[str, object]:
+    """End-of-run gate for the chaos harnesses: export hold-time
+    histograms, raise on any recorded cycle (full both-stack detail),
+    return flat summary stats for the harness's stats dict."""
+    rep = report()
+    export_metrics()
+    if rep["cycles"]:
+        detail = "\n\n".join(str(v) for v in violations())
+        raise AssertionError(
+            f"lockcheck: {rep['cycles']} lock-order cycle(s) detected "
+            f"under BYTEPS_LOCKCHECK — the run proved a deadlock is "
+            f"reachable:\n{detail}")
+    return {"lockcheck.locks": rep["locks_tracked"],
+            "lockcheck.edges": rep["edges"],
+            "lockcheck.cycles": 0}
+
+
+def report() -> Dict[str, object]:
+    """Summary for the chaos harnesses: cycle count + hold-time
+    top-offenders (by max hold)."""
+    with _graph_lock:
+        holds = {
+            site: {"count": hs.count, "total_s": round(hs.total, 6),
+                   "max_s": round(hs.max, 6)}
+            for site, hs in _holds.items()}
+        return {
+            "locks_tracked": len(holds),
+            "edges": len(_edges),
+            "cycles": len(_violations),
+            "violations": [str(v).splitlines()[0] for v in _violations],
+            "holds": dict(sorted(holds.items(),
+                                 key=lambda kv: -kv[1]["max_s"])[:10]),
+        }

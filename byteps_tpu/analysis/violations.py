@@ -1,0 +1,116 @@
+"""Violation records and the reviewed-suppressions baseline.
+
+Every analysis pass (locks, envknobs, metricnames, protocols) reports
+:class:`Violation` objects.  A violation's identity — what the baseline
+suppresses — is its :attr:`Violation.key`: ``rule:path:symbol:detail``,
+deliberately **line-number free** so refactors that move code without
+changing its locking/protocol shape do not churn the baseline.
+
+The baseline file (``.analysis-baseline.json`` at the repo root) is a
+reviewed artifact: every entry carries a one-line ``reason`` explaining
+why the flagged pattern is acceptable.  ``scripts/lint.py`` fails on
+
+  * any violation whose key is NOT in the baseline (new debt), and
+  * any baseline entry without a non-empty reason (unreviewed debt),
+
+and *warns* on stale entries (suppressed keys that no longer fire) so
+fixed violations get their suppressions retired.  See
+docs/analysis.md "Baseline workflow".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Sequence, Tuple
+
+__all__ = ["Violation", "Baseline", "load_baseline", "apply_baseline"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One finding.  ``rule`` is the pass's stable rule id
+    (docs/analysis.md "Rule catalog"); ``path`` is repo-relative;
+    ``symbol`` is the enclosing ``Class.method`` (or ``<module>``);
+    ``detail`` disambiguates multiple findings in one symbol (the
+    attribute, the blocking callee, the op name, ...); ``line`` is
+    display-only and excluded from the baseline key."""
+
+    rule: str
+    path: str
+    symbol: str
+    detail: str
+    message: str
+    line: int = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.rule}:{self.path}:{self.symbol}:{self.detail}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.rule}] "
+                f"{self.symbol}: {self.message}")
+
+
+class Baseline:
+    """Parsed suppressions: key -> reason."""
+
+    def __init__(self, entries: Dict[str, str], path: str = ""):
+        self.entries = entries
+        self.path = path
+
+    def reasonless(self) -> List[str]:
+        return [k for k, r in self.entries.items()
+                if not str(r or "").strip()]
+
+
+def load_baseline(path: str) -> Baseline:
+    """Read ``.analysis-baseline.json``.  A missing file is an empty
+    baseline (fresh trees lint clean or fail loudly)."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except FileNotFoundError:
+        return Baseline({}, path)
+    entries: Dict[str, str] = {}
+    for item in data.get("suppressions", []):
+        entries[str(item["key"])] = str(item.get("reason", ""))
+    return Baseline(entries, path)
+
+
+def apply_baseline(
+    violations: Sequence[Violation], baseline: Baseline
+) -> Tuple[List[Violation], List[Violation], List[str]]:
+    """Split findings into (new, suppressed, stale_keys)."""
+    new: List[Violation] = []
+    suppressed: List[Violation] = []
+    fired = set()
+    for v in violations:
+        if v.key in baseline.entries:
+            suppressed.append(v)
+            fired.add(v.key)
+        else:
+            new.append(v)
+    stale = [k for k in baseline.entries if k not in fired]
+    return new, suppressed, stale
+
+
+def dump_baseline(violations: Sequence[Violation], path: str,
+                  reasons: Dict[str, str] | None = None,
+                  keep: Dict[str, str] | None = None) -> None:
+    """Write a baseline covering ``violations`` (``--update-baseline``).
+    Reasons default to TODO markers that the reasonless check then
+    forces a human to fill in — an auto-regenerated baseline can never
+    silently launder new debt into reviewed debt.  ``keep`` carries
+    key->reason entries preserved verbatim alongside the findings — a
+    rule-filtered update passes the other rules' reviewed entries here
+    so a partial run can never destroy them."""
+    reasons = reasons or {}
+    entries = dict(keep or {})
+    for v in violations:
+        entries[v.key] = reasons.get(v.key, "TODO: review and justify")
+    items = [{"key": k, "reason": entries[k]} for k in sorted(entries)]
+    with open(path, "w") as f:
+        json.dump({"version": 1, "suppressions": items}, f, indent=2,
+                  sort_keys=False)
+        f.write("\n")

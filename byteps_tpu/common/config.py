@@ -102,11 +102,20 @@ class Config:
     # --- modes -----------------------------------------------------------
     enable_async: bool = False  # async PS mode (docs/env.md "Asynchronous")
     use_hash_key: bool = False  # key->server sharding (global.cc:305-334)
+    # explicit async-PS shard addresses "host:port,host:port"; "" =
+    # derive from the DMLC contract (root port + 100 + shard index)
+    server_addrs: str = ""
 
     # --- logging / debug (reference logging.cc:95-113, core_loops.cc:33) -
     log_level: str = "WARNING"
+    log_hide_time: bool = False  # drop the asctime prefix (test logs)
     debug_sample_tensor: str = ""
     trace_path: str = ""  # chrome-trace output ("" = disabled)
+
+    # --- analysis (byteps_tpu/analysis/ — docs/analysis.md): runtime
+    # lock-order/deadlock detector; chaos runs set it so every schedule
+    # they drive also proves deadlock-freedom -------------------------
+    lockcheck: bool = False
 
     # --- observability (byteps_tpu/observability/; docs/observability.md.
     # The reference's story stops at per-process trace files — these
@@ -292,7 +301,10 @@ class Config:
             force_distributed=_env_bool("BYTEPS_FORCE_DISTRIBUTED"),
             enable_async=_env_bool("BYTEPS_ENABLE_ASYNC"),
             use_hash_key=_env_bool("BYTEPS_USE_HASH_KEY"),
+            server_addrs=_env_str("BYTEPS_SERVER_ADDRS", ""),
             log_level=_env_str("BYTEPS_LOG_LEVEL", "WARNING"),
+            log_hide_time=_env_bool("BYTEPS_LOG_HIDE_TIME"),
+            lockcheck=_env_bool("BYTEPS_LOCKCHECK"),
             debug_sample_tensor=_env_str("BYTEPS_DEBUG_SAMPLE_TENSOR", ""),
             trace_path=_env_str("BYTEPS_TRACE_PATH", ""),
             metrics_port=_env_int("BYTEPS_METRICS_PORT", 0),

@@ -10,7 +10,6 @@ the ``[rank]``-tagged variant used throughout the reference's core loops.
 from __future__ import annotations
 
 import logging
-import os
 import sys
 
 _LEVELS = {
@@ -40,7 +39,7 @@ def get_logger() -> logging.Logger:
     logger.setLevel(_LEVELS.get(level_name, logging.WARNING))
     if not logger.handlers:
         handler = logging.StreamHandler(sys.stderr)
-        if os.environ.get("BYTEPS_LOG_HIDE_TIME"):
+        if get_config().log_hide_time:
             fmt = "[%(levelname)s] %(message)s"
         else:
             fmt = "%(asctime)s [%(levelname)s] %(message)s"

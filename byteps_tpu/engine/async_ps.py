@@ -278,15 +278,20 @@ def _server_addrs_from_env() -> List[str]:
     """Worker-side server discovery: explicit ``BYTEPS_SERVER_ADDRS``
     ("host:port,host:port"), else derived from the DMLC contract the way the
     reference's ps-lite rendezvous hands out server ports (root port + 100 +
-    server index)."""
+    server index).  The ``BYTEPS_*`` knobs come through the typed config
+    (env-raw-read lint): a ``set_config()`` override now steers discovery
+    too, instead of the raw env silently winning."""
     import os
 
-    explicit = os.environ.get("BYTEPS_SERVER_ADDRS", "")
-    if explicit:
-        return [a.strip() for a in explicit.split(",") if a.strip()]
+    from ..common.config import get_config
+
+    cfg = get_config()
+    if cfg.server_addrs:
+        return [a.strip() for a in cfg.server_addrs.split(",")
+                if a.strip()]
     uri = os.environ.get("DMLC_PS_ROOT_URI", "")
     nserver = int(os.environ.get("DMLC_NUM_SERVER", "0") or "0")
-    if uri and nserver > 0 and os.environ.get("BYTEPS_ENABLE_ASYNC", "0") == "1":
+    if uri and nserver > 0 and cfg.enable_async:
         root = int(os.environ.get("DMLC_PS_ROOT_PORT", "1234"))
         return [f"{uri}:{root + 100 + i}" for i in range(nserver)]
     return []

@@ -234,15 +234,19 @@ class ServerProfiler:
         """Drain and terminate the JSON array (valid strict JSON)."""
         self.flush()
         with self._io_lock:
-            self._closed = True
             # last-chance drain INSIDE the io lock: a record() batch
             # appended after flush()'s swap (too small to trip the
             # autoflush) would otherwise stay buffered forever with no
-            # drop log — write it before terminating the array (the
-            # _closed flag set above makes any batch still racing
-            # toward _write() drop loudly instead of corrupting the
-            # closed file)
+            # drop log — write it before terminating the array.  The
+            # _closed flag is set under BOTH locks: record() checks it
+            # under _lock, so flipping it inside this _lock hold closes
+            # the window where a record() racing close() passed the
+            # check and buffered events AFTER the straggler swap —
+            # silently burying them with no drop log (the TOCTOU the
+            # lock-discipline lint flagged here); _write() still checks
+            # under _io_lock, which close() also holds
             with self._lock:
+                self._closed = True
                 stragglers, self._events = self._events, []
             if stragglers:
                 self._append_locked(stragglers)

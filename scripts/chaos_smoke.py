@@ -70,10 +70,17 @@ def run(steps: int = 60, seed: int = 0, rate: float = 0.15,
         dim: int = 16, verbose: bool = True,
         compression: str = "", window: int = None,
         partition_bytes: int = None, transport: str = None,
-        kill_shard_at: int = None, hierarchical: bool = False) -> dict:
+        kill_shard_at: int = None, hierarchical: bool = False,
+        lockcheck: bool = False) -> dict:
     import dataclasses
 
+    from byteps_tpu.analysis import runtime as lockrt
     from byteps_tpu.common.config import get_config, set_config
+
+    # runtime lock-order detector (--lockcheck / BYTEPS_LOCKCHECK=1,
+    # docs/analysis.md): the run then ALSO proves the schedule it drove
+    # is deadlock-free, on top of the bit-for-bit verdict
+    lockrt.install_if(lockcheck)
     from byteps_tpu.compression import CompressionPolicy
     from byteps_tpu.engine import ps_server
     from byteps_tpu.resilience import (FaultInjectingProxy,
@@ -96,10 +103,19 @@ def run(steps: int = 60, seed: int = 0, rate: float = 0.15,
     if overrides:
         set_config(dataclasses.replace(saved_cfg, **overrides))
     try:
-        return _run(steps, seed, rate, dim, verbose, compression, window,
-                    transport, kill_shard_at,
-                    ps_server, CompressionPolicy, FaultInjectingProxy,
-                    ResilienceCounters, RetryPolicy)
+        stats = _run(steps, seed, rate, dim, verbose, compression,
+                     window, transport, kill_shard_at,
+                     ps_server, CompressionPolicy, FaultInjectingProxy,
+                     ResilienceCounters, RetryPolicy)
+        if lockrt.enabled():
+            # zero-cycle gate: raises with both acquisition stacks on
+            # any lock-order cycle the faulted schedule reached
+            stats.update(lockrt.chaos_verdict())
+            if verbose:
+                print(f"  lockcheck: {stats['lockcheck.locks']} lock "
+                      f"sites, {stats['lockcheck.edges']} order edges, "
+                      f"0 cycles")
+        return stats
     finally:
         set_config(saved_cfg)
 
@@ -254,13 +270,18 @@ def main() -> int:
                          "(local_size 4) so the exactly-once bar runs "
                          "per slice (docs/wire.md 'Hierarchical "
                          "reduction')")
+    ap.add_argument("--lockcheck", action="store_true",
+                    help="instrument Lock/RLock/Condition and fail on "
+                         "any lock-order cycle the run reaches "
+                         "(BYTEPS_LOCKCHECK=1 equivalent; "
+                         "docs/analysis.md)")
     ap.add_argument("--dim", type=int, default=16)
     args = ap.parse_args()
     run(steps=args.steps, seed=args.seed, rate=args.rate,
         compression=args.compression, window=args.window,
         partition_bytes=args.partition_bytes, dim=args.dim,
         transport=args.transport, kill_shard_at=args.kill_shard_at,
-        hierarchical=args.hierarchical)
+        hierarchical=args.hierarchical, lockcheck=args.lockcheck)
     return 0
 
 

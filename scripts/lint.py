@@ -1,0 +1,126 @@
+"""Project lint: concurrency / env-knob / metric-name / wire-protocol
+static analysis against the reviewed suppressions baseline.
+
+Runs every pass in ``byteps_tpu/analysis/`` over the package and fails
+(exit 1) on:
+
+  * any violation not suppressed in ``.analysis-baseline.json``, or
+  * any baseline entry without a one-line ``reason``.
+
+Stale suppressions (fixed violations whose entries linger) are warned
+about but do not fail — retire them in the PR that fixed them.
+
+Usage:
+    python scripts/lint.py                       # all rules
+    python scripts/lint.py --rule lock-blocking-call --rule env-raw-read
+    python scripts/lint.py --list                # every finding incl. baselined
+    python scripts/lint.py --update-baseline     # rewrite baseline (reasons
+                                                 # become TODOs you must fill)
+
+Wired into tier-1 as ``tests/test_analysis.py::test_lint_tree_clean``
+(fast: pure AST, no jax import).  Rule catalog, baseline workflow and
+the "the lint failed my PR" recipe: docs/analysis.md + docs/faq.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import os
+import sys
+import types
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, _REPO)
+
+
+def _import_analysis():
+    """Import ``byteps_tpu.analysis`` WITHOUT executing
+    ``byteps_tpu/__init__.py`` (which imports the api and therefore
+    jax).  The passes are pure stdlib + AST; registering a bare parent
+    package keeps the lint at ~1 s of AST work and runnable on
+    jax-less hosts.  In-process callers (tests) that already imported
+    the real package are untouched."""
+    if "byteps_tpu" not in sys.modules:
+        pkg = types.ModuleType("byteps_tpu")
+        pkg.__path__ = [os.path.join(_REPO, "byteps_tpu")]
+        sys.modules["byteps_tpu"] = pkg
+    return importlib.import_module("byteps_tpu.analysis")
+
+
+def main(argv=None) -> int:
+    _import_analysis()
+    runner = importlib.import_module("byteps_tpu.analysis.runner")
+    vio = importlib.import_module("byteps_tpu.analysis.violations")
+    ALL_RULES, BASELINE_FILE = runner.ALL_RULES, runner.BASELINE_FILE
+    repo_root, run_all = runner.repo_root, runner.run_all
+    dump_baseline, load_baseline = vio.dump_baseline, vio.load_baseline
+
+    ap = argparse.ArgumentParser(
+        description="byteps_tpu static analysis lint")
+    ap.add_argument("--rule", action="append", choices=ALL_RULES,
+                    help="run only these rules (repeatable)")
+    ap.add_argument("--list", action="store_true",
+                    help="print every finding, including baselined")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline to cover current "
+                         "findings (existing reasons kept; new "
+                         "entries get TODO reasons that still fail "
+                         "the lint until reviewed)")
+    ap.add_argument("--root", default=None, help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    root = args.root or repo_root()
+    res = run_all(root=root, rules=args.rule)
+
+    if args.update_baseline:
+        path = os.path.join(root, BASELINE_FILE)
+        old = load_baseline(path)
+        keep = {}
+        if args.rule:
+            # a rule-filtered update must preserve the OTHER rules'
+            # reviewed entries verbatim — res.all_violations only
+            # covers the selected rules, and replacing the whole file
+            # from it would destroy every other suppression
+            prefixes = tuple(f"{r}:" for r in args.rule)
+            keep = {k: r for k, r in old.entries.items()
+                    if not k.startswith(prefixes)}
+        dump_baseline(res.all_violations, path, reasons=old.entries,
+                      keep=keep)
+        print(f"wrote {len(set(v.key for v in res.all_violations)) + len(keep)} "
+              f"suppressions to {path}")
+        return 0
+
+    if args.list:
+        for v in res.all_violations:
+            mark = "  (baselined)" if v in res.suppressed else ""
+            print(v.render() + mark)
+        print(f"{len(res.all_violations)} findings "
+              f"({len(res.suppressed)} baselined)")
+
+    rc = 0
+    if res.new:
+        print(f"lint: {len(res.new)} NEW violation(s) "
+              f"(not in {BASELINE_FILE}):", file=sys.stderr)
+        for v in res.new:
+            print("  " + v.render(), file=sys.stderr)
+        print("fix them, or baseline each with a reviewed one-line "
+              "reason (docs/analysis.md, docs/faq.md)", file=sys.stderr)
+        rc = 1
+    if res.reasonless:
+        print(f"lint: {len(res.reasonless)} baseline entr(ies) without "
+              f"a reason:", file=sys.stderr)
+        for k in res.reasonless:
+            print("  " + k, file=sys.stderr)
+        rc = 1
+    for k in res.stale:
+        print(f"lint: stale suppression (no longer fires): {k}",
+              file=sys.stderr)
+    if rc == 0 and not args.list:
+        print(f"lint OK: {len(res.suppressed)} baselined, "
+              f"{len(res.stale)} stale, 0 new")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

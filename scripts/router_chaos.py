@@ -65,12 +65,23 @@ import numpy as np
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
+def _maybe_lockcheck(lockcheck: bool):
+    """Install the runtime lock-order detector (--lockcheck /
+    BYTEPS_LOCKCHECK=1, docs/analysis.md) and return the module for the
+    end-of-run zero-cycle verdict (None = off)."""
+    from byteps_tpu.analysis import runtime as lockrt
+
+    return lockrt if lockrt.install_if(lockcheck) else None
+
+
 def run(requests: int = 12, seed: int = 0, n_replicas: int = 3,
         temperature: float = 0.0, fault_rate: float = 0.12,
         kill: bool = True, drain: bool = True,
-        verbose: bool = True) -> dict:
+        verbose: bool = True, lockcheck: bool = False) -> dict:
     import jax
     import jax.numpy as jnp
+
+    lockrt = _maybe_lockcheck(lockcheck)
 
     from byteps_tpu.inference import generate
     from byteps_tpu.models.transformer import (Transformer,
@@ -281,6 +292,8 @@ def run(requests: int = 12, seed: int = 0, n_replicas: int = 3,
             assert stats["redispatches"] >= 1
         if drain:
             assert drain_ok is True
+        if lockrt is not None:
+            stats.update(lockrt.chaos_verdict())
         return stats
     finally:
         router.close()
@@ -297,12 +310,15 @@ def run(requests: int = 12, seed: int = 0, n_replicas: int = 3,
 
 def run_router_kill(requests: int = 10, seed: int = 0,
                     n_replicas: int = 3, temperature: float = 0.0,
-                    kill_at: int = 3, verbose: bool = True) -> dict:
+                    kill_at: int = 3, verbose: bool = True,
+                    lockcheck: bool = False) -> dict:
     """The ``--kill-router-at N`` leg: active-router death mid-stream
     with a journal-fed standby and multi-router clients (see module
     docstring)."""
     import jax
     import jax.numpy as jnp
+
+    lockrt = _maybe_lockcheck(lockcheck)
 
     from byteps_tpu.inference import generate
     from byteps_tpu.models.transformer import (Transformer,
@@ -487,6 +503,8 @@ def run_router_kill(requests: int = 10, seed: int = 0,
         assert stats["takeovers"] == 1
         assert stats["fenced_replicas"] == len(rep_addrs)
         assert stats["max_duration_s"] < deadline + 30.0
+        if lockrt is not None:
+            stats.update(lockrt.chaos_verdict())
         return stats
     finally:
         proxy.close()
@@ -517,18 +535,23 @@ def main(argv=None) -> int:
                          "victim after N frames, kill the ACTIVE "
                          "router there, and prove takeover + epoch "
                          "fencing")
+    ap.add_argument("--lockcheck", action="store_true",
+                    help="instrument locks and fail on any lock-order "
+                         "cycle (BYTEPS_LOCKCHECK=1 equivalent; "
+                         "docs/analysis.md)")
     args = ap.parse_args(argv)
     if args.kill_router_at > 0:
         run_router_kill(requests=args.requests, seed=args.seed,
                         n_replicas=args.replicas,
                         temperature=args.temperature,
-                        kill_at=args.kill_router_at)
+                        kill_at=args.kill_router_at,
+                        lockcheck=args.lockcheck)
         print("router chaos (router kill): OK", flush=True)
         return 0
     run(requests=args.requests, seed=args.seed,
         n_replicas=args.replicas, temperature=args.temperature,
         fault_rate=args.fault_rate, kill=not args.no_kill,
-        drain=not args.no_drain)
+        drain=not args.no_drain, lockcheck=args.lockcheck)
     print("router chaos: OK", flush=True)
     return 0
 

@@ -60,9 +60,16 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 def run(requests: int = 12, seed: int = 0, n_slots: int = 4,
         temperature: float = 0.0, verbose: bool = True,
         prefix_share: bool = False, paged: bool = False,
-        spec: int = 0) -> dict:
+        spec: int = 0, lockcheck: bool = False) -> dict:
     import jax
     import jax.numpy as jnp
+
+    from byteps_tpu.analysis import runtime as lockrt
+
+    # --lockcheck / BYTEPS_LOCKCHECK=1 (docs/analysis.md): the parity
+    # verdict below then also proves the threaded-arrival schedule is
+    # deadlock-free (zero lock-order cycles)
+    lockrt.install_if(lockcheck)
 
     from byteps_tpu.inference import generate
     from byteps_tpu.models.transformer import (Transformer,
@@ -207,6 +214,8 @@ def run(requests: int = 12, seed: int = 0, n_slots: int = 4,
              **engine.metrics.snapshot()}
     if paged:
         stats["block_stats"] = engine.pool.block_stats()
+    if lockrt.enabled():
+        stats.update(lockrt.chaos_verdict())
     if verbose:
         print(stats)
     return stats
@@ -231,13 +240,17 @@ def main(argv=None) -> int:
                          "sequential baselines with one verify program "
                          "per depth bucket; combine with --paged to "
                          "exercise preempt/resume mid-speculation")
+    ap.add_argument("--lockcheck", action="store_true",
+                    help="instrument locks and fail on any lock-order "
+                         "cycle (BYTEPS_LOCKCHECK=1 equivalent; "
+                         "docs/analysis.md)")
     args = ap.parse_args(argv)
     ok = True
     for temp in (0.0, 0.8):
         stats = run(requests=args.requests, seed=args.seed,
                     n_slots=args.slots, temperature=temp,
                     prefix_share=args.prefix_share, paged=args.paged,
-                    spec=args.spec)
+                    spec=args.spec, lockcheck=args.lockcheck)
         # paged engines compile one decode program per gather
         # high-water bucket (pos-capped gather); dense engines exactly
         # one — either way, traces == buckets pins retrace-freedom

@@ -90,6 +90,31 @@ def test_chaos_smoke_hierarchical_sliced_exactly_once_with_failover():
 
 
 @pytest.mark.slow
+def test_chaos_smoke_full_bar_under_lockcheck():
+    """ISSUE 15 acceptance: the full chaos bar — compression + EF,
+    pipelined window, partitioned tensors, a deterministic mid-run
+    shard kill — passes bit-for-bit under ``BYTEPS_LOCKCHECK=1`` with
+    zero lock-order cycles reported: the faulted schedule (retries,
+    window aborts, failover re-seed) is deadlock-free, not just
+    exactly-once (docs/analysis.md "Runtime lock-order detector")."""
+    import chaos_smoke
+    from byteps_tpu.analysis import runtime as lockrt
+
+    try:
+        stats = chaos_smoke.run(steps=40, seed=1, rate=0.27,
+                                verbose=False, compression="randomk",
+                                window=8, partition_bytes=24, dim=64,
+                                kill_shard_at=30, lockcheck=True)
+    finally:
+        lockrt.uninstall()
+        lockrt.reset()
+    assert stats["faults"] > 0
+    assert stats.get("resilience.failover", 0) >= 1
+    assert stats["lockcheck.cycles"] == 0
+    assert stats["lockcheck.locks"] > 0
+
+
+@pytest.mark.slow
 def test_chaos_smoke_pipelined_partitioned_exactly_once():
     """PR 4 acceptance (docs/wire.md): the pipelined wire client —
     in-flight window, partitioned tensors fanned out across shards,

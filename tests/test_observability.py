@@ -567,22 +567,12 @@ class TestServingObservability:
         assert snap["histograms"]["serve.ttft_s"]["count"] == 10
 
 
-# ------------------------------------------------------------- env.md lint
-
-
-def test_every_config_knob_is_documented_in_env_md():
-    """Every BYTEPS_* env var read by common/config.py must have a row
-    in docs/env.md (the recurring undocumented-knob drift)."""
-    cfg_src = open(os.path.join(REPO, "byteps_tpu/common/config.py")).read()
-    knobs = set(re.findall(r'_env_[a-z_]+\(\s*"(BYTEPS_[A-Z0-9_]+)"',
-                           cfg_src))
-    assert len(knobs) > 30, "config parse failed?"
-    docs = open(os.path.join(REPO, "docs/env.md")).read()
-    documented = set(re.findall(r"`(BYTEPS_[A-Z0-9_]+)`", docs))
-    missing = sorted(knobs - documented)
-    assert not missing, (
-        f"BYTEPS knobs read by common/config.py but missing from "
-        f"docs/env.md: {missing}")
+# The env.md knob lint that lived here (PR 6's
+# test_every_config_knob_is_documented_in_env_md) moved into the
+# analysis subsystem: byteps_tpu/analysis/envknobs.py, exercised by
+# tests/test_analysis.py::test_every_config_knob_documented and
+# scripts/lint.py — AST-accurate, and extended to flag raw BYTEPS_*
+# environ reads anywhere in the package.
 
 
 # ------------------------------------------------------------ bench (slow)
