@@ -657,6 +657,77 @@ def hierarchical_ab(workers=4, mb=2, delay_ms=5.0, steps=3, shards=2,
     return rows
 
 
+def registered_recv_ab(kb=64, reps=2000, archive=True):
+    """Registered-buffer receive A/B (the carried-over ps-lite-van
+    gap): ps-lite's RDMA van registers each receive buffer once and
+    reuses it for every message, while our ``_recv_exact`` allocates a
+    fresh ``bytearray`` per frame.  The hardware half (verbs
+    registration, NIC DMA) is gated on ``rdma_available()`` — absent
+    here — so this measures the hardware-independent half on a UNIX
+    socketpair: per-frame allocation vs a recycled
+    :class:`~byteps_tpu.engine.transport.RegisteredBufferPool` buffer,
+    at the disagg KV-ship frame size (one paged block, tens of KB —
+    where the allocator, not the copy, is the marginal cost).  Rows
+    archive into BENCH_COMM.json under ``wire_registered_recv_*``."""
+    import socket as _socket
+
+    from byteps_tpu.engine.transport import (RegisteredBufferPool,
+                                             rdma_available)
+    from byteps_tpu.engine.wire import _recv_exact
+
+    n = kb * 1024
+    payload = b"\xab" * n
+    a, b = _socket.socketpair()
+    pool = RegisteredBufferPool()
+    rows = []
+    try:
+        a.setblocking(True)
+        b.setblocking(True)
+
+        def _run(recv_one):
+            # warm
+            for _ in range(8):
+                a.sendall(payload)
+                recv_one()
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                a.sendall(payload)
+                recv_one()
+            return (time.perf_counter() - t0) / reps
+
+        plain = _run(lambda: _recv_exact(b, n))
+
+        def _pooled():
+            view = pool.recv_exact(b, n)
+            pool.recycle(view)
+
+        pooled = _run(_pooled)
+        st = pool.stats()
+        for tag, dt in (("plain", plain), ("pooled", pooled)):
+            row = {
+                "metric": f"wire_registered_recv_{tag}_{kb}kb_us",
+                "value": round(dt * 1e6, 2),
+                "unit": "us/frame",
+                "frame_kb": kb,
+                "mb_per_s": round(n / dt / 1e6, 1),
+                "vs_plain": round(plain / dt, 3),
+                "rdma_available": rdma_available(),
+                "pool_hit_rate": (round(st["hits"] /
+                                        max(1, st["hits"] + st["misses"]),
+                                        3) if tag == "pooled" else None),
+                "wire": "socketpair, single frame",
+                "tool": "bench_comm.py",
+            }
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+    finally:
+        a.close()
+        b.close()
+    if archive and rows:
+        _archive_rows(rows)
+    return rows
+
+
 def _archive_rows(rows, path="BENCH_COMM.json"):
     """Merge rows into BENCH_COMM.json by metric name (acceptance
     artifact: the pipelined-wire numbers live next to the PR-4-era
@@ -700,6 +771,7 @@ def main():
     if args.transports_only:
         transport_ab(mb=args.transport_mb, reps=args.transport_reps,
                      archive=not args.no_archive)
+        registered_recv_ab(archive=not args.no_archive)
         return
     if args.hierarchical:
         hierarchical_ab(workers=args.hier_workers, mb=args.hier_mb,

@@ -1069,6 +1069,153 @@ def router_affinity(groups: int = 3, per_group: int = 8,
     return row
 
 
+def disagg_ab(shorts: int = 4, longs: int = 2, tokens: int = 16,
+              short_len: int = 8, long_lens=(16, 64), slots: int = 6,
+              d_model: int = 32, layers: int = 2, vocab: int = 61,
+              block: int = 8, chunk: int = 16,
+              out_path: str = "BENCH_SERVE.json", archive: bool = True):
+    """Disaggregated-vs-colocated A/B on the mixed long/short leg
+    (docs/serving.md "Disaggregated tiers" — ROADMAP item 1's
+    acceptance signal).
+
+    Two paged replicas either share every role (colocated — today's
+    tier) or split into one prefill + one decode replica (disagg).
+    The workload is ``shorts`` latency-critical decode streams with
+    ``longs`` long-prompt requests arriving mid-decode, swept over
+    ``long_lens``.  Colocated, the long prompts' chunked prefill
+    interleaves with decode ticks on the same engine, so short-request
+    decode TPOT p99 grows with prompt length; disaggregated, prefill
+    runs tier-separate and only the block adoption (a device-side
+    scatter) touches the decode replica, so TPOT p99 stays flat.  Every
+    stream is asserted token-identical to sequential ``generate()`` —
+    the A/B measures latency shape, never correctness."""
+    from byteps_tpu.observability.metrics import MetricsRegistry
+    from byteps_tpu.serving import ServeRouter
+    from byteps_tpu.serving import router as rt
+    from byteps_tpu.serving.frontend import serve
+
+    max_seq = -(-(max(long_lens) + tokens + block) // block) * block
+    cfg = TransformerConfig(vocab_size=vocab, num_layers=layers,
+                            num_heads=2, d_model=d_model,
+                            d_ff=2 * d_model, max_seq_len=max_seq,
+                            dtype=jnp.float32)
+    model = Transformer(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))
+    short_ps = _prompts(shorts, short_len, vocab)
+    long_ps = {L: _prompts(longs, L, vocab) for L in long_lens}
+    refs = {}
+    for p in short_ps:
+        refs[p.tobytes()] = list(np.asarray(generate(
+            model, variables, p[None], tokens,
+            temperature=0.0)["tokens"])[0])
+    for L in long_lens:
+        for p in long_ps[L]:
+            refs[p.tobytes()] = list(np.asarray(generate(
+                model, variables, p[None], tokens,
+                temperature=0.0)["tokens"])[0])
+
+    def run_leg(disagg: bool, L: int):
+        engines = [ServingEngine(model, variables, n_slots=slots,
+                                 max_seq=max_seq, temperature=0.0,
+                                 paged=True, block=block, chunk=chunk,
+                                 metrics=ServeMetrics())
+                   for _ in range(2)]
+        for e in engines:
+            e.start()
+            e.submit(short_ps[0], 2).result(timeout=120.0)
+        srvs = [serve(e, 0, host="127.0.0.1", in_thread=True)[0]
+                for e in engines]
+        addrs = ["127.0.0.1:%d" % s.server_address[1] for s in srvs]
+        router = ServeRouter(
+            addrs, roles=["prefill", "decode"] if disagg else None,
+            disagg=disagg, affinity=True, credits=slots,
+            deadline=120.0, stream_timeout=30.0,
+            registry=MetricsRegistry())
+        tpot, mism = [], []
+        lock = threading.Lock()
+
+        def worker(p, is_short):
+            t0 = time.perf_counter()
+            first = None
+            toks = []
+            for tok in router.stream(p, tokens):
+                if first is None:
+                    first = time.perf_counter()
+                toks.append(tok)
+            t1 = time.perf_counter()
+            with lock:
+                if toks != refs[p.tobytes()]:
+                    mism.append(p.tobytes())
+                if is_short and len(toks) > 1 and first is not None:
+                    tpot.append((t1 - first) / (len(toks) - 1))
+
+        try:
+            threads = [threading.Thread(target=worker, args=(p, True),
+                                        daemon=True) for p in short_ps]
+            for t in threads:
+                t.start()
+            time.sleep(0.05)  # longs land while shorts are decoding
+            lthreads = [threading.Thread(target=worker, args=(p, False),
+                                         daemon=True)
+                        for p in long_ps[L]]
+            for t in lthreads:
+                t.start()
+            for t in threads + lthreads:
+                t.join(180.0)
+            st = router.stats()
+            return {"tpot_p50_s": _pctl(tpot, 50),
+                    "tpot_p99_s": _pctl(tpot, 99),
+                    "mismatches": len(mism),
+                    "shipped_blocks": st[rt.DISAGG_SHIPPED_BLOCKS],
+                    "prefill_legs": st[rt.DISAGG_PREFILLS],
+                    "fallbacks": st[rt.DISAGG_FALLBACKS],
+                    "shipped_bytes": sum(
+                        e.metrics.get(sm.KV_BLOCKS_SHIPPED_BYTES)
+                        for e in engines)}
+        finally:
+            router.close()
+            for s in srvs:
+                s.shutdown()
+                s.server_close()
+
+    legs = {}
+    for disagg in (False, True):
+        for L in long_lens:
+            legs[("disagg" if disagg else "colocated", L)] = \
+                run_leg(disagg, L)
+    mode_rows = {}
+    for mode in ("colocated", "disagg"):
+        per_len = {L: legs[(mode, L)] for L in long_lens}
+        lo, hi = per_len[min(long_lens)], per_len[max(long_lens)]
+        mode_rows[mode] = {
+            "tpot_p99_by_long_len": {str(L): per_len[L]["tpot_p99_s"]
+                                     for L in long_lens},
+            "tpot_p99_growth": round(
+                hi["tpot_p99_s"] / max(lo["tpot_p99_s"], 1e-9), 3),
+            "mismatches": sum(v["mismatches"] for v in per_len.values()),
+            "shipped_blocks": sum(v["shipped_blocks"]
+                                  for v in per_len.values()),
+            "shipped_bytes": sum(v["shipped_bytes"]
+                                 for v in per_len.values()),
+            "prefill_legs": sum(v["prefill_legs"]
+                                for v in per_len.values()),
+            "fallbacks": sum(v["fallbacks"] for v in per_len.values()),
+        }
+    row = {"metric": "serve_disagg_mixed", "shorts": shorts,
+           "longs": longs, "tokens": tokens, "short_len": short_len,
+           "long_lens": list(long_lens), "replicas": 2,
+           "d_model": d_model, "layers": layers, "block": block,
+           "chunk": chunk, "colocated": mode_rows["colocated"],
+           "disagg": mode_rows["disagg"],
+           "mismatches": (mode_rows["colocated"]["mismatches"]
+                          + mode_rows["disagg"]["mismatches"])}
+    print(json.dumps(row), flush=True)
+    if archive:
+        _archive_rows([row], out_path)
+    return row
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tokens", type=int, default=None,
@@ -1105,6 +1252,11 @@ def main(argv=None) -> int:
                          "standby journal: steady vs mid-run ACTIVE-"
                          "ROUTER kill; completion rate, mismatches, "
                          "takeover-window TTFT tail)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="run only the disaggregated-vs-colocated "
+                         "mixed long/short A/B (short-request decode "
+                         "TPOT p99 vs long-prompt length, shipped-"
+                         "block counters, parity asserted)")
     ap.add_argument("--spec", action="store_true",
                     help="run only the speculative-decoding A/B "
                          "(repetitive leg: accepted-tokens/tick + TPOT "
@@ -1112,6 +1264,21 @@ def main(argv=None) -> int:
                          "spec-on vs spec-off interleaved reps, parity "
                          "asserted)")
     args = ap.parse_args(argv)
+    if args.disagg:
+        row = disagg_ab(out_path=args.out,
+                        archive=not args.no_archive)
+        dis, col = row["disagg"], row["colocated"]
+        ok = (row["mismatches"] == 0 and dis["shipped_blocks"] > 0
+              and dis["tpot_p99_growth"] <= col["tpot_p99_growth"])
+        print(f"disagg mixed leg: decode TPOT p99 growth with prompt "
+              f"length {dis['tpot_p99_growth']}x disagg vs "
+              f"{col['tpot_p99_growth']}x colocated, "
+              f"{dis['shipped_blocks']} blocks "
+              f"({dis['shipped_bytes']} B) shipped, "
+              f"{dis['fallbacks']} fallbacks "
+              f"({'PASS' if ok else 'FAIL'} 0 mismatches, ships "
+              f"happened, flatter TPOT growth)")
+        return 0 if ok else 1
     if args.spec:
         row = spec_decode(reps=args.reps, out_path=args.out,
                           archive=not args.no_archive)

@@ -68,7 +68,9 @@ PROTOCOLS: Tuple[ProtocolSpec, ...] = (
         docs=("docs/wire.md",),
     ),
     # Serving protocol: clients -> serve frontend, reused verbatim by
-    # the router tier (same ports, same frames) and the HA journal op
+    # the router tier (same ports, same frames), the HA journal op,
+    # and the disagg KV-block ship (OP_KV_BLOCKS: produced by the
+    # prefill side's ship sender, dispatched by the decode frontend)
     ProtocolSpec(
         name="serve",
         const_modules=("byteps_tpu/serving/frontend.py",),
@@ -76,7 +78,8 @@ PROTOCOLS: Tuple[ProtocolSpec, ...] = (
                         "byteps_tpu/serving/router.py"),
         client_modules=("byteps_tpu/serving/frontend.py",
                         "byteps_tpu/serving/router.py",
-                        "byteps_tpu/serving/journal.py"),
+                        "byteps_tpu/serving/journal.py",
+                        "byteps_tpu/serving/disagg/ship.py"),
         docs=("docs/serving.md",),
     ),
 )

@@ -230,6 +230,22 @@ class Config:
     # the tenant= submit param; unknown/untagged share the "default"
     # bucket, weight 1 unless configured).  "" = fair share off.
     router_tenant_weights: str = ""
+    # per-replica serving roles "prefill,decode,both,..." positionally
+    # matching router_replicas (docs/serving.md "Disaggregated tiers").
+    # "" = every replica serves both roles (colocated, the default).
+    router_roles: str = ""
+    # master switch for disaggregated prefill/decode placement when
+    # router_roles names at least one prefill replica; off = prefill
+    # replicas are simply skipped by decode placement (drain mode)
+    disagg: bool = True
+    # per-block ack deadline on the prefill->decode KV ship leg
+    disagg_ship_timeout_ms: float = 10_000.0
+    # digest-mismatch retries per shipped block before the sender
+    # aborts the ship and the router falls back to decode-side re-prefill
+    disagg_ship_retries: int = 2
+    # max finished-but-unshipped parked KV entries a prefill engine
+    # holds (refcounted blocks; oldest evicted + released beyond this)
+    disagg_parked_cap: int = 32
 
     # --- pipelined wire engine (byteps_tpu/engine/wire.py; the client
     # half of the push/pull pipelining BytePS keeps the wire busy with —
@@ -372,6 +388,12 @@ class Config:
                 "BYTEPS_ROUTER_EPOCH_TIMEOUT_MS", 500.0),
             router_tenant_weights=_env_str(
                 "BYTEPS_ROUTER_TENANT_WEIGHTS", ""),
+            router_roles=_env_str("BYTEPS_ROUTER_ROLES", ""),
+            disagg=_env_bool("BYTEPS_DISAGG", True),
+            disagg_ship_timeout_ms=_env_float(
+                "BYTEPS_DISAGG_SHIP_TIMEOUT_MS", 10_000.0),
+            disagg_ship_retries=_env_int("BYTEPS_DISAGG_SHIP_RETRIES", 2),
+            disagg_parked_cap=_env_int("BYTEPS_DISAGG_PARKED_CAP", 32),
             wire_window=_env_int("BYTEPS_WIRE_WINDOW", 8),
             wire_fanout=_env_int("BYTEPS_WIRE_FANOUT", 16),
             transport=_env_str("BYTEPS_TRANSPORT", "auto"),

@@ -80,9 +80,10 @@ from typing import Dict, Optional, Tuple
 from ..common import logging as bps_log
 
 __all__ = [
-    "KINDS", "ShmConnection", "LocalEndpoints", "connection_kind",
-    "endpoint_path", "is_local_host", "maybe_nodelay", "parse_overrides",
-    "peer_label", "resolve_transport", "transport_connect", "transport_dir",
+    "KINDS", "RegisteredBufferPool", "ShmConnection", "LocalEndpoints",
+    "connection_kind", "endpoint_path", "is_local_host", "maybe_nodelay",
+    "parse_overrides", "peer_label", "rdma_available", "resolve_transport",
+    "transport_connect", "transport_dir",
 ]
 
 KINDS = ("tcp", "unix", "shm")
@@ -819,3 +820,108 @@ class LocalEndpoints:
                     os.unlink(p)
                 except OSError:
                     pass
+
+
+# ------------------------------------------------- registered buffers
+
+
+def rdma_available() -> bool:
+    """True when an RDMA verbs stack is importable — the gate for the
+    hardware half of the registered-buffer experiment (ps-lite's RDMA
+    van registers its buffers with the NIC so the HCA can DMA without
+    page-pinning per message).  This container has no verbs stack, so
+    the software half below is what runs; the gate keeps the seam
+    honest instead of stubbing verbs calls that could never execute."""
+    try:  # pragma: no cover - hardware-specific
+        import pyverbs  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+class RegisteredBufferPool:
+    """Preallocated, recycled receive buffers — the software half of
+    ps-lite's registered-memory idea (RDMAVan pins each buffer once and
+    reuses it for every message; ours cannot pin without verbs, but the
+    allocator-pressure half of the win is hardware-independent).
+
+    The wire codec's ``_recv_exact`` allocates a fresh ``bytearray`` per
+    frame; at disagg KV-ship rates (one multi-KB frame per block) that
+    is an allocation per block on the receive path.  A pool caller does
+
+        buf = pool.acquire(n)      # recycled when a fit exists
+        ... sock.recv_into(memoryview(buf)[...]) ...
+        pool.release(buf)          # back to the free list
+
+    Buffers are bucketed by power-of-two capacity and handed out
+    oversized (callers slice to ``n``); the pool holds at most
+    ``max_buffers`` free buffers per bucket and ``max_bytes`` total —
+    beyond that, release drops the buffer to the allocator (bounded
+    memory, no leak on bursty frame sizes).  Thread-safe; acquisition
+    never blocks (a miss just allocates)."""
+
+    def __init__(self, max_buffers: int = 8,
+                 max_bytes: int = 64 * 1024 * 1024):
+        self.max_buffers = int(max_buffers)
+        self.max_bytes = int(max_bytes)
+        self._lock = threading.Lock()
+        self._free: Dict[int, list] = {}
+        self._held_bytes = 0
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def _bucket(n: int) -> int:
+        b = 4096
+        while b < n:
+            b <<= 1
+        return b
+
+    def acquire(self, n: int) -> bytearray:
+        """A buffer of capacity >= ``n`` (callers slice their view)."""
+        b = self._bucket(n)
+        with self._lock:
+            lst = self._free.get(b)
+            if lst:
+                self.hits += 1
+                self._held_bytes -= b
+                return lst.pop()
+            self.misses += 1
+        return bytearray(b)
+
+    def release(self, buf: bytearray) -> None:
+        b = len(buf)
+        with self._lock:
+            lst = self._free.setdefault(b, [])
+            if (len(lst) < self.max_buffers
+                    and self._held_bytes + b <= self.max_bytes):
+                lst.append(buf)
+                self._held_bytes += b
+            # else: drop to the allocator — bounded pool
+
+    def recv_exact(self, sock, n: int) -> memoryview:
+        """``_recv_exact`` against a pooled buffer: a length-``n``
+        memoryview whose backing buffer came from (and must go back
+        to) this pool via :meth:`recycle`."""
+        buf = self.acquire(n)
+        view = memoryview(buf)[:n]
+        got = 0
+        while got < n:
+            r = sock.recv_into(view[got:])
+            if r == 0:
+                self.release(buf)
+                raise ConnectionError(
+                    f"peer closed mid-frame ({got}/{n} bytes)")
+            got += r
+        return view
+
+    def recycle(self, view: memoryview) -> None:
+        """Return a :meth:`recv_exact` view's backing buffer."""
+        self.release(view.obj)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"hits": self.hits, "misses": self.misses,
+                    "held_bytes": self._held_bytes,
+                    "free_buffers": sum(len(v)
+                                        for v in self._free.values())}

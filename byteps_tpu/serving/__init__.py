@@ -48,6 +48,11 @@ kernels into a *serving engine*:
     deterministically at a fenced epoch on active death, and
     multi-router clients re-issue mid-stream with ``resume`` —
     docs/serving.md "Router tier" / "Router HA";
+  * ``disagg`` — disaggregated prefill/decode tiers (docs/serving.md
+    "Disaggregated tiers"): prefill-role replicas ship finished-prompt
+    KV as paged blocks over ``OP_KV_BLOCKS`` to the decode replica the
+    router chose, which adopts them through the resume machinery —
+    bit-exact, with decode-side re-prefill as the availability floor;
   * ``metrics`` — TTFT/TPOT/queue-wait and occupancy/tokens-per-sec
     counters exported through the process ``Tracer``.
 
@@ -61,6 +66,16 @@ from .blocks import (  # noqa: F401
     BlocksExhaustedError,
     BlockTable,
     PagedSlotPool,
+)
+from .disagg import (  # noqa: F401
+    KVShipAbortedError,
+    KVShipDigestError,
+    KVShipError,
+    KVShipGeometryError,
+    KVShipSequenceError,
+    KVStager,
+    pool_geometry,
+    ship_parked,
 )
 from .engine import (  # noqa: F401
     EpochFencedError,

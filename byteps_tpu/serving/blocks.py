@@ -344,6 +344,24 @@ class PagedSlotPool(SlotPool):
         self.tables[slot].share(self.alloc, ids)
         self._tables_dirty = True
 
+    def adopt_blocks(self, slot: int, ids: Sequence[int]) -> None:
+        """Attach ``ids`` to an empty ``slot`` table as an *ownership
+        transfer*: unlike :meth:`share_prefix` no refcounts are bumped —
+        the caller's references (a disagg ship's staged blocks, already
+        allocated/incref'd on this pool) become the table's, and
+        ``reset_locked`` releases them like any granted block."""
+        t = self.tables[slot]
+        if t.blocks:
+            raise ValueError(
+                f"adopt_blocks: slot {slot} table is not empty "
+                f"({len(t.blocks)} block(s))")
+        if len(ids) > t.max_blocks:
+            raise ValueError(
+                f"adopt_blocks: {len(ids)} blocks exceed the slot's "
+                f"max_blocks={t.max_blocks}")
+        t.blocks.extend(int(b) for b in ids)
+        self._tables_dirty = True
+
     def make_writable(self, slot: int, lo_pos: int, hi_pos: int,
                       copy_cb) -> int:
         """Copy-on-write fork of any *shared* block backing positions

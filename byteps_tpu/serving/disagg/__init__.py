@@ -1,0 +1,28 @@
+"""Disaggregated prefill/decode serving tiers (docs/serving.md
+"Disaggregated tiers").
+
+BytePS's core move — split one monolithic role into specialized tiers
+connected by a push/pull wire — applied to serving: **prefill**
+replicas run chunked prefill only and ship the finished request's KV
+as flat paged blocks over a new ``OP_KV_BLOCKS`` wire op; **decode**
+replicas scatter the blocks into their own ``PagedSlotPool``, seed the
+slot at the prompt cursor through the existing ``resume_tokens``/
+parked-key machinery, and decode as if they had prefilled locally —
+bit-exact by the position-wise determinism argument, greedy and
+seeded.  The router (serving/router.py) owns role-aware placement and
+both failure legs: a prefill replica dying mid-ship falls back to
+decode-side re-prefill (the PR 10 resume path — disaggregation can
+never be *less* available than colocated serving), and a decode
+replica dying after the ship re-enters normal failover.
+"""
+
+from .ship import (  # noqa: F401
+    KVShipAbortedError,
+    KVShipDigestError,
+    KVShipError,
+    KVShipGeometryError,
+    KVShipSequenceError,
+    KVStager,
+    pool_geometry,
+    ship_parked,
+)

@@ -1,0 +1,352 @@
+"""KV block shipping: the prefill -> decode wire leg of disaggregation.
+
+One ``OP_KV_BLOCKS`` frame per paged block, sent on a fresh connection
+to the decode replica's serve frontend:
+
+    name    = JSON {"key", "i", "n", "pos", "geom", "digest"}
+    payload = the block's raw K/V bytes, every layer's caches
+              concatenated in sorted-key order (scatter-gather views —
+              no user-space copy on the send path)
+
+``key`` is the router-minted ship id the decode-leg dispatch later
+claims the staged blocks under; ``i``/``n`` sequence the blocks so a
+torn or reordered ship is detected (``KVShipSequenceError`` aborts the
+whole staging — partial KV is *never* silently attended); ``digest``
+is a per-block blake2b-128 over the payload, verified before the block
+is scattered into the pool (a corrupt block is refused typed and the
+sender retries it, bounded by ``BYTEPS_DISAGG_SHIP_RETRIES``);
+``geom`` commits both pools to the same (layers, block size, per-block
+elements, dtype) tuple.  The geometry is layout-agnostic on purpose:
+a grouped ``[block, KV, D]`` row and a flat ``[block, KV*D]`` row are
+byte-identical in row-major order, so a grouped-pool prefill replica
+can ship to a flat-pool decode replica.
+
+Every failure mode downgrades, never corrupts: the sender surfaces a
+typed ``KVShipError`` subclass, the frontend reports ``{"shipped":
+False}`` alongside the (still valid) first token, and the router falls
+back to decode-side re-prefill — the PR 10 resume path, so
+disaggregation can never be *less* available than colocated serving.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import struct
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...common import logging as bps_log
+from ...engine.wire import _decode, _encode, _payload_view, _send_buffers
+from .. import metrics as sm
+
+__all__ = ["KVShipError", "KVShipGeometryError", "KVShipSequenceError",
+           "KVShipDigestError", "KVShipAbortedError", "KVStager",
+           "pool_geometry", "ship_parked", "on_block_sent"]
+
+
+class KVShipError(RuntimeError):
+    """Base of the typed ship failures.  Every subclass means the same
+    thing to the router: this request's KV did not arrive whole — fall
+    back to decode-side re-prefill."""
+
+
+class KVShipGeometryError(KVShipError):
+    """The two pools disagree on (layers, block, per-block elements,
+    dtype) — nothing can be shipped between them."""
+
+
+class KVShipSequenceError(KVShipError):
+    """A block arrived out of order (or for an unknown ship): the
+    staging is torn and has been aborted receiver-side."""
+
+
+class KVShipDigestError(KVShipError):
+    """A block's payload failed its blake2b check.  The receiver's
+    expected index is unchanged — the sender retries the same block."""
+
+
+class KVShipAbortedError(KVShipError):
+    """The ship died wholesale: unreachable decode replica, connection
+    cut mid-transfer, receiver out of blocks, or an unrecognized typed
+    refusal."""
+
+
+# test/chaos hook: called as on_block_sent(key, i, n) after each block
+# is ACKed by the receiver.  scripts/router_chaos.py --kill-prefill-at
+# uses it to kill the prefill replica after exactly N shipped blocks;
+# an exception raised here aborts the ship like a wire cut.
+on_block_sent = None
+
+
+def pool_geometry(engine) -> str:
+    """The compatibility string both ends of a ship must agree on:
+    layer count, block size, and per-block element count + dtype for
+    every cache tensor (sorted-key order — the payload order).  Layout
+    (grouped vs flat) is deliberately absent: the row-major bytes are
+    identical either way."""
+    pool = engine.pool
+    c0 = pool.caches[0]
+    parts = [f"L{len(pool.caches)}", f"B{pool.block}"]
+    for k in sorted(c0):
+        a = c0[k]
+        parts.append(f"{k}={int(np.prod(a.shape[1:]))}:{a.dtype}")
+    return "/".join(parts)
+
+
+def _frame_buffers(op: int, meta: dict, payload_bufs, plen: int) -> List:
+    """Hand-built arr-less frame (name=JSON meta, raw payload) as a
+    scatter-gather buffer list — byte-identical to
+    ``_encode(op, json.dumps(meta), None, raw=payload)`` without the
+    user-space join of the block's K/V views."""
+    nb = json.dumps(meta).encode()
+    head = struct.pack("<BI", op, len(nb)) + nb
+    head += struct.pack("<I", 0)   # dtype tag: none (raw payload)
+    head += struct.pack("<B", 0)   # ndim 0
+    head += struct.pack("<Q", plen)
+    return [head, *payload_bufs]
+
+
+def _digest(bufs) -> str:
+    h = hashlib.blake2b(digest_size=16)
+    for b in bufs:
+        h.update(b)
+    return h.hexdigest()
+
+
+_TYPED_SHIP_ERRORS = {
+    "KVShipGeometryError": KVShipGeometryError,
+    "KVShipSequenceError": KVShipSequenceError,
+    "KVShipDigestError": KVShipDigestError,
+    "KVShipAbortedError": KVShipAbortedError,
+}
+
+
+def ship_parked(engine, addr: str, key: str, parked: dict, *,
+                metrics=None, transport: Optional[str] = None) -> dict:
+    """Ship a parked prefill's KV blocks to the decode replica at
+    ``addr`` under ship id ``key``.  ``parked`` is the engine's
+    ``take_parked_kv`` entry; the CALLER keeps ownership of its block
+    refs (release them in a ``finally`` — this function only reads).
+    Returns ``{"shipped": True, "blocks": n, "bytes": total}``; raises
+    a :class:`KVShipError` subclass on any failure."""
+    from ...common.config import get_config
+    from ..frontend import OP_KV_BLOCKS
+    from ...engine.transport import resolve_transport, transport_connect
+
+    cfg = get_config()
+    ids = parked["ids"]
+    n = len(ids)
+    geom = pool_geometry(engine)
+    t0 = time.monotonic()
+    # one locked device gather + host copy for the whole ship; the
+    # per-block sends below slice views out of it
+    layers = engine.extract_kv_blocks(ids)
+    keys_per_layer = [sorted(layer) for layer in layers]
+    kind, path = resolve_transport(addr, transport or cfg.transport)
+    try:
+        sock = transport_connect(kind, path, addr,
+                                 timeout=cfg.disagg_ship_timeout_ms / 1e3)
+    except OSError as e:
+        raise KVShipAbortedError(
+            f"decode replica {addr} unreachable for KV ship: {e}") from e
+    total = 0
+    try:
+        try:
+            for i in range(n):
+                bufs = [_payload_view(np.ascontiguousarray(layer[k][i]))
+                        for layer, ks in zip(layers, keys_per_layer)
+                        for k in ks]
+                plen = sum(len(b) for b in bufs)
+                meta = {"key": key, "i": i, "n": n,
+                        "pos": int(parked["pos"]), "geom": geom,
+                        "digest": _digest(bufs)}
+                attempts = 0
+                while True:
+                    _send_buffers(sock, _frame_buffers(
+                        OP_KV_BLOCKS, meta, bufs, plen))
+                    status, _, _, payload = _decode(sock)
+                    if status == 0:
+                        break
+                    msg = payload.decode()
+                    ename = msg.split(":", 1)[0].strip()
+                    if (ename == "KVShipDigestError"
+                            and attempts < cfg.disagg_ship_retries):
+                        attempts += 1
+                        bps_log.warning(
+                            "disagg ship %s: block %d/%d digest refused, "
+                            "retry %d", key, i, n, attempts)
+                        continue
+                    raise _TYPED_SHIP_ERRORS.get(
+                        ename, KVShipAbortedError)(msg)
+                total += plen
+                if metrics is not None:
+                    metrics.bump(sm.KV_BLOCKS_SHIPPED)
+                    metrics.bump(sm.KV_BLOCKS_SHIPPED_BYTES, plen)
+                hook = on_block_sent
+                if hook is not None:
+                    hook(key, i, n)
+        except (ConnectionError, OSError, ValueError) as e:
+            raise KVShipAbortedError(
+                f"KV ship {key} to {addr} died after {total} bytes: "
+                f"{type(e).__name__}: {e}") from e
+    finally:
+        try:
+            sock.close()
+        except OSError:
+            pass
+    if metrics is not None:
+        metrics._hist("ship").observe(time.monotonic() - t0)
+    return {"shipped": True, "blocks": n, "bytes": total}
+
+
+class _Staged:
+    __slots__ = ("ids", "n", "pos", "next", "t")
+
+
+class KVStager:
+    """Decode-side receiver: verifies, stages, and hands over shipped
+    KV blocks.
+
+    Blocks for a ship are allocated from the engine's pool UP FRONT at
+    block 0 (``BlocksExhaustedError`` propagates typed — the sender
+    aborts and the router re-prefills) and scattered in as frames
+    arrive.  ``take(key)`` consumes a COMPLETE staging for the decode
+    dispatch's adoption; a partial one is released, never adopted.
+    Stranded entries (the router died between ship and dispatch, or
+    the request finished at the prefill leg) are TTL-swept."""
+
+    def __init__(self, engine, ttl: float = 60.0):
+        self.engine = engine
+        self.ttl = ttl
+        self._lock = threading.Lock()
+        self._entries: Dict[str, _Staged] = {}
+        self._geom = pool_geometry(engine)
+        # static payload schema: (key, tail shape, dtype) per cache
+        # tensor per layer, snapshotted once — reading live caches per
+        # frame would race the tick thread's donated buffers
+        self._schema = [
+            [(k, tuple(int(d) for d in c[k].shape[1:]),
+              np.dtype(str(c[k].dtype))) for k in sorted(c)]
+            for c in engine.pool.caches]
+        self._block_bytes = sum(
+            int(np.prod(shape)) * dt.itemsize
+            for layer in self._schema for _, shape, dt in layer)
+
+    # ------------------------------------------------------------- wire
+
+    def handle(self, name: str, payload) -> bytes:
+        """One OP_KV_BLOCKS frame -> one encoded reply frame.  Typed
+        ship errors ride status=1 with the error-name prefix the sender
+        maps back; anything else propagates to the handler's generic
+        error reply."""
+        try:
+            ack = self._accept(name, payload)
+        except KVShipError as e:
+            return _encode(1, "", None,
+                           f"{type(e).__name__}: {e}".encode())
+        return _encode(0, "", None, json.dumps(ack).encode())
+
+    def _accept(self, name: str, payload) -> dict:
+        meta = json.loads(name)
+        key, i, n = str(meta["key"]), int(meta["i"]), int(meta["n"])
+        if meta.get("geom") != self._geom:
+            raise KVShipGeometryError(
+                f"pool geometry mismatch: ship says {meta.get('geom')!r},"
+                f" this pool is {self._geom!r}")
+        if len(payload) != self._block_bytes:
+            raise KVShipGeometryError(
+                f"block payload is {len(payload)} bytes, this pool's "
+                f"blocks are {self._block_bytes}")
+        self.sweep()
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None:
+                if i != 0:
+                    raise KVShipSequenceError(
+                        f"block {i} for unknown ship {key} — torn "
+                        f"staging refused")
+                ent = _Staged()
+                # allocate the WHOLE staging up front: a mid-ship pool
+                # exhaustion would strand a half-written staging
+                ent.ids = self.engine.stage_alloc(n)
+                ent.n = n
+                ent.pos = int(meta["pos"])
+                ent.next = 0
+                ent.t = time.monotonic()
+                self._entries[key] = ent
+            if i != ent.next:
+                stale = self._entries.pop(key)
+                self.engine.release_kv_ids(stale.ids)
+                raise KVShipSequenceError(
+                    f"ship {key}: got block {i}, expected {ent.next} — "
+                    f"staging aborted")
+        # digest + scatter OUTSIDE the stager lock (hash and device
+        # write are the slow parts; frames for one key are serial on
+        # their connection, so ent is not contended)
+        h = hashlib.blake2b(digest_size=16)
+        h.update(payload)
+        if h.hexdigest() != meta.get("digest"):
+            # expected index unchanged: the sender resends this block
+            raise KVShipDigestError(
+                f"ship {key} block {i}/{n}: payload digest mismatch")
+        self.engine.write_kv_block(ent.ids[i], self._split(payload))
+        with self._lock:
+            if self._entries.get(key) is ent:
+                ent.next = i + 1
+                ent.t = time.monotonic()
+        return {"i": i, "complete": bool(ent.next >= n)}
+
+    def _split(self, payload) -> List[Dict[str, np.ndarray]]:
+        mv = memoryview(payload)
+        out: List[Dict[str, np.ndarray]] = []
+        off = 0
+        for layer in self._schema:
+            d = {}
+            for k, shape, dt in layer:
+                nb = int(np.prod(shape)) * dt.itemsize
+                d[k] = np.frombuffer(
+                    mv[off:off + nb], dtype=dt).reshape(shape)
+                off += nb
+            out.append(d)
+        return out
+
+    # --------------------------------------------------------- handover
+
+    def take(self, key: str) -> Optional[dict]:
+        """Claim the staged entry for ``key``.  A COMPLETE staging
+        transfers block ownership to the caller (``{"ids", "pos"}``);
+        a partial or unknown one returns None (partials are released
+        here — the torn ship is never attended)."""
+        with self._lock:
+            ent = self._entries.pop(key, None)
+        if ent is None:
+            return None
+        if ent.next >= ent.n:
+            return {"ids": ent.ids, "pos": ent.pos}
+        bps_log.warning(
+            "disagg: ship %s claimed at %d/%d blocks — releasing the "
+            "torn staging, decode falls back to re-prefill",
+            key, ent.next, ent.n)
+        self.engine.release_kv_ids(ent.ids)
+        return None
+
+    def sweep(self) -> int:
+        """Release stagings idle past the TTL (the router died between
+        ship and dispatch, or the request needed no decode leg)."""
+        now = time.monotonic()
+        dead = []
+        with self._lock:
+            for k in list(self._entries):
+                if now - self._entries[k].t > self.ttl:
+                    dead.append(self._entries.pop(k))
+        for ent in dead:
+            self.engine.release_kv_ids(ent.ids)
+        return len(dead)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"staged": len(self._entries)}

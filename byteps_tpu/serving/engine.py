@@ -174,6 +174,13 @@ class Request:
         default=None, repr=False)
     _resume_key: Optional[np.ndarray] = dataclasses.field(
         default=None, repr=False)
+    # disaggregated serving (serving/disagg): keep_kv parks the
+    # finished request's paged blocks for a ship instead of freeing
+    # them; _kv_blocks carries staged block ids a decode-side admit
+    # adopts in place of re-running prefill
+    _keep_kv: bool = dataclasses.field(default=False, repr=False)
+    _kv_blocks: Optional[List[int]] = dataclasses.field(
+        default=None, repr=False)
     # anti-thrash watermark: a preempted request is re-admitted only
     # once this many blocks are free (its worst-case remaining need) —
     # eagerly re-admitting it would re-prefill, collide with the same
@@ -622,6 +629,18 @@ class ServingEngine:
         self._copy_fn = None
         self._extract_fn = None
         self._cow_fn = None
+        # disaggregated serving (serving/disagg): finished-but-unshipped
+        # parked KV — req.id -> {"ids": [block ids, incref'd], "pos": T}
+        # — plus the lazily-jitted single-block scatter the decode-side
+        # stager writes received blocks with.  Bounded by
+        # BYTEPS_DISAGG_PARKED_CAP (oldest evicted + released).
+        from collections import OrderedDict
+
+        from ..common.config import get_config
+
+        self._kv_write_fn = None
+        self._parked_kv: "OrderedDict[int, dict]" = OrderedDict()
+        self._parked_cap = max(1, get_config().disagg_parked_cap)
 
     # ---------------------------------------------------- jitted programs
     #
@@ -993,6 +1012,81 @@ class ServingEngine:
         self.pool.caches = self._cow_fn(self.pool.caches,
                                         jnp.int32(src), jnp.int32(dst))
 
+    # ------------------------------------------- disagg KV ship seam
+    #
+    # The prefill side of a disaggregated ship reads parked blocks out
+    # of the pool (extract_kv_blocks); the decode side scatters received
+    # blocks in (write_kv_block).  Both run under ``self._lock``: the
+    # tick thread DONATES ``pool.caches`` into every step, so an
+    # unlocked reader could hold a deleted buffer mid-copy.
+
+    def take_parked_kv(self, req_id: int) -> Optional[dict]:
+        """Claim (and remove) the parked KV entry a finished ``keep_kv``
+        request left behind.  The caller owns the returned block refs
+        and must ``release_kv_ids`` them when done."""
+        with self._lock:
+            return self._parked_kv.pop(req_id, None)
+
+    def release_kv_ids(self, ids) -> None:
+        """Drop one reference per block id (parked entries, refused
+        adoptions, aborted stagings)."""
+        if not ids:
+            return
+        with self._lock:
+            for b in ids:
+                self.pool.alloc.decref(int(b))
+
+    def stage_alloc(self, n: int) -> List[int]:
+        """Allocate ``n`` pool blocks for an incoming ship (decode
+        side); raises ``BlocksExhaustedError`` when the pool cannot
+        cover it — the sender aborts and the router re-prefills."""
+        with self._lock:
+            return self.pool.alloc.alloc(n)
+
+    def extract_kv_blocks(self, ids) -> List[Dict[str, np.ndarray]]:
+        """Host copies of the pool rows backing ``ids``: one dict per
+        layer, each value ``[len(ids), ...block row]`` — the ship
+        payload.  Row-major bytes are layout-identical between the
+        grouped and flat pool layouts (same trailing element count), so
+        the wire format does not encode the layout."""
+        idx = jnp.asarray(list(ids), jnp.int32)
+        with self._lock:
+            return [{n: np.asarray(jnp.take(c[n], idx, axis=0))
+                     for n in c} for c in self.pool.caches]
+
+    def write_kv_block(self, bid: int, layers) -> None:
+        """Scatter ONE received block into the pool at physical id
+        ``bid``.  ``layers`` is ``extract_kv_blocks``'s per-layer dict
+        shape for a single block (leading axis dropped).  One compiled
+        program total — the block id is a traced scalar."""
+        if self._kv_write_fn is None:
+            def kv_write(pcaches, bid, blk):
+                return tuple(
+                    {n: c[n].at[bid].set(blk[i][n]) for n in c}
+                    for i, c in enumerate(pcaches))
+
+            self._kv_write_fn = jax.jit(kv_write, donate_argnums=(0,))
+        with self._lock:
+            self.pool.caches = self._kv_write_fn(
+                self.pool.caches, jnp.int32(bid), tuple(layers))
+
+    def _park_kv_locked(self, req: Request) -> None:
+        """Park a finished ``keep_kv`` request's blocks (incref BEFORE
+        the slot free releases the table's own refs) so the frontend
+        can ship them after the reply.  Cap-bounded: the oldest parked
+        entry is evicted and released, never silently grown."""
+        ids = list(self.pool.tables[req.slot].blocks)
+        if not ids:
+            return
+        for b in ids:
+            self.pool.alloc.incref(b)
+        seq = req._seq if req._seq is not None else req.prompt
+        self._parked_kv[req.id] = {"ids": ids, "pos": int(len(seq))}
+        while len(self._parked_kv) > self._parked_cap:
+            _, old = self._parked_kv.popitem(last=False)
+            for b in old["ids"]:
+                self.pool.alloc.decref(int(b))
+
     def _prefill_fn(self, bucket: int):
         fn = self._prefill_fns.get(bucket)
         if fn is not None:
@@ -1109,7 +1203,8 @@ class ServingEngine:
 
     def submit(self, prompt, max_new_tokens: int, *, seed: int = 0,
                priority: int = 0, resume_tokens=None,
-               epoch: Optional[int] = None) -> Request:
+               epoch: Optional[int] = None, keep_kv: bool = False,
+               kv_blocks=None) -> Request:
         """Enqueue a generation request.  Raises ``ValueError`` on an
         infeasible request and ``QueueFullError`` (typed backpressure)
         when the bounded admission queue is at capacity.
@@ -1130,17 +1225,26 @@ class ServingEngine:
         recoverable by construction (a pure function of ``seed`` and
         ``k``); ``max_new_tokens`` stays the request's TOTAL budget and
         the resumed tokens count against it (only new tokens are
-        streamed; ``result()`` returns the full sequence)."""
+        streamed; ``result()`` returns the full sequence).
+
+        ``keep_kv`` (disagg prefill replicas) parks the finished
+        request's paged blocks for a post-reply ship instead of freeing
+        them; ``kv_blocks`` (disagg decode replicas) carries staged,
+        already-written block ids whose adoption replaces the prefill
+        pass entirely (docs/serving.md "Disaggregated tiers")."""
         if epoch is not None:
             with self.epoch_fence(epoch):
                 return self._submit(prompt, max_new_tokens, seed=seed,
                                     priority=priority,
-                                    resume_tokens=resume_tokens)
+                                    resume_tokens=resume_tokens,
+                                    keep_kv=keep_kv, kv_blocks=kv_blocks)
         return self._submit(prompt, max_new_tokens, seed=seed,
-                            priority=priority, resume_tokens=resume_tokens)
+                            priority=priority, resume_tokens=resume_tokens,
+                            keep_kv=keep_kv, kv_blocks=kv_blocks)
 
     def _submit(self, prompt, max_new_tokens: int, *, seed: int,
-                priority: int, resume_tokens) -> Request:
+                priority: int, resume_tokens, keep_kv: bool = False,
+                kv_blocks=None) -> Request:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         T = int(prompt.shape[0])
         if T < 1:
@@ -1171,6 +1275,12 @@ class ServingEngine:
                 req.state = RequestState.DONE
                 req._out.put(_END)
                 req._done.set()
+                if kv_blocks is not None and self.paged:
+                    # staged disagg blocks for a request that needs no
+                    # decoding: nothing will adopt them — release now
+                    for b in kv_blocks:
+                        self.pool.alloc.decref(int(b))
+                    kv_blocks = None
             self.metrics.bump(sm.SUBMITTED)
             self.metrics.bump(sm.COMPLETED)  # 0 tokens generated here
             return req
@@ -1224,6 +1334,10 @@ class ServingEngine:
                 req._resume_tok = resumed[-1]
                 if not self.greedy:
                     req._resume_key = _resume_key_chain(seed, len(resumed))
+            req._keep_kv = bool(keep_kv and self.paged)
+            if kv_blocks is not None and self.paged:
+                req._kv_blocks = [int(b) for b in kv_blocks]
+                kv_blocks = None  # ownership moved to the request
             if self._trace_rpc:
                 # join the caller's active trace (a submit inside a
                 # traced client op) or mint a fresh id for this request
@@ -1405,6 +1519,37 @@ class ServingEngine:
             req.t_admit = time.monotonic()
             self.metrics.bump(sm.ADMITTED)
         self._slot_req[slot] = req
+        if req._kv_blocks is not None:
+            # disagg adoption: a shipped prefill's staged blocks replace
+            # the prefill pass.  The table adopts them (ownership
+            # transfer — the stager's refs become the table's), the
+            # cursor is already at T from assign(), and the parked
+            # resume pair seeds the next decode input exactly like the
+            # chunked-resume path below — bit-exact by the position-wise
+            # determinism argument (docs/serving.md "Disaggregated
+            # tiers").  Any geometry surprise refuses adoption and falls
+            # through to normal (re-)prefill — never a wrong answer.
+            ids, req._kv_blocks = req._kv_blocks, None
+            if (self.paged and req._resume_tok is not None
+                    and len(ids) == -(-T // self.pool.block)
+                    and len(ids) <= self.pool.tables[slot].max_blocks
+                    and not self.pool.tables[slot].blocks):
+                self.pool.adopt_blocks(slot, ids)
+                req.state = RequestState.ACTIVE
+                self._tok = self._tok.at[slot].set(req._resume_tok)
+                if not self.greedy and req._resume_key is not None:
+                    self._keys = self._keys.at[slot].set(
+                        jnp.asarray(req._resume_key))
+                req._resume_tok = None
+                req._resume_key = None
+                return 0
+            bps_log.warning(
+                "disagg: refusing adoption of %d staged block(s) for "
+                "request %d (want %d for T=%d) — re-prefilling",
+                len(ids), req.id, -(-T // self.pool.block)
+                if self.paged else -1, T)
+            for b in ids:
+                self.pool.alloc.decref(int(b))
         p0 = 0
         if self.prefix is not None:
             req._prefix_digs = self.prefix.digests_for(
@@ -2009,10 +2154,22 @@ class ServingEngine:
                     trace_id=req.trace_id, state=state.value,
                     tokens=len(req.tokens))
         if req.slot is not None:
+            if (req._keep_kv and state is RequestState.DONE
+                    and self.paged):
+                # disagg prefill replica: park the finished request's
+                # blocks (extra refs, taken BEFORE the free below drops
+                # the table's own) so the frontend can ship them
+                self._park_kv_locked(req)
             self._prefilling.pop(req.slot, None)
             self._slot_req[req.slot] = None
             self.pool.free(req.slot)
             req.slot = None
+        if req._kv_blocks is not None:
+            # staged blocks that were never adopted (cancel/failure
+            # before admission): release, never leak
+            for b in req._kv_blocks:
+                self.pool.alloc.decref(int(b))
+            req._kv_blocks = None
         req._out.put(_END)
         req._done.set()
         if state is RequestState.DONE:

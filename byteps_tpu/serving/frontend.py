@@ -86,11 +86,17 @@ from .engine import Request, ServingEngine
 from .scheduler import AdmissionError
 
 OP_SUBMIT, OP_STATS, OP_PING, OP_STREAM, OP_CANCEL, OP_JOURNAL = range(6)
+# disaggregated prefill/decode (serving/disagg, docs/serving.md
+# "Disaggregated tiers"): one frame per shipped KV block — name = JSON
+# {"key","i","n","pos","geom","digest"}, payload = the block's raw K/V
+# bytes.  Replies: status=0 JSON ack, or status=1 with a typed
+# KVShip* error name the sender maps to retry/abort.
+OP_KV_BLOCKS = 6
 
 __all__ = ["ServeClient", "ServeFrontend", "RemoteServeClient",
            "ServeConnectionError", "ServeReplyError", "serve",
            "serve_from_env", "OP_SUBMIT", "OP_STATS", "OP_PING",
-           "OP_STREAM", "OP_CANCEL", "OP_JOURNAL"]
+           "OP_STREAM", "OP_CANCEL", "OP_JOURNAL", "OP_KV_BLOCKS"]
 
 
 class ServeConnectionError(ConnectionError):
@@ -210,20 +216,44 @@ def _wire_cancel(addr: str, params: dict, timeout: Optional[float],
     return bool(json.loads(payload.decode()).get("cancelled"))
 
 
-def _parse_submit(engine: ServingEngine, name: str, arr):
-    """Decode a SUBMIT/STREAM frame into an engine submit."""
+def _parse_submit(engine: ServingEngine, name: str, arr, stager=None):
+    """Decode a SUBMIT/STREAM frame into an engine submit.
+
+    Disagg params (docs/serving.md "Disaggregated tiers"): a PREFILL
+    dispatch carries ``ship_to`` (the decode replica's address) +
+    ``kv_ship`` (the ship id) — the engine parks the finished KV for
+    the post-reply ship.  A DECODE dispatch carries ``kv_ship`` alone:
+    the staged blocks are claimed from the stager here and adopted at
+    admission in place of re-prefill; a missing/partial staging just
+    means normal (re-)prefill — never a wrong answer."""
     params = json.loads(name) if name else {}
     prompt, resumed = _split_resume(params, arr)
+    kv = None
+    if (stager is not None and params.get("kv_ship")
+            and not params.get("ship_to")):
+        staged = stager.take(str(params["kv_ship"]))
+        if staged is not None:
+            if staged["pos"] == int(prompt.shape[0]):
+                kv = staged["ids"]
+            else:
+                engine.release_kv_ids(staged["ids"])
     # the router-epoch fence rides INTO the submit so check and
     # admission are atomic: a deposed router's dispatch must be refused
     # typed, never admitted (the split-brain guard — docs/serving.md
     # "Router HA")
-    req = engine.submit(
-        prompt, int(params.get("max_new_tokens", 16)),
-        seed=int(params.get("seed", 0)),
-        priority=int(params.get("priority", 0)),
-        resume_tokens=resumed,
-        epoch=params.get("epoch"))
+    try:
+        req = engine.submit(
+            prompt, int(params.get("max_new_tokens", 16)),
+            seed=int(params.get("seed", 0)),
+            priority=int(params.get("priority", 0)),
+            resume_tokens=resumed,
+            epoch=params.get("epoch"),
+            keep_kv=bool(params.get("ship_to")),
+            kv_blocks=kv)
+    except Exception:
+        # the engine takes block ownership only on a successful return
+        engine.release_kv_ids(kv)
+        raise
     return req, params
 
 
@@ -267,12 +297,14 @@ class _ServeHandler(socketserver.BaseRequestHandler):
         try:
             while True:
                 try:
-                    op, name, arr, _ = _decode(sock)
+                    op, name, arr, payload_in = _decode(sock)
                 except (ConnectionError, OSError):
                     return
                 try:
                     if op in (OP_SUBMIT, OP_STREAM):
-                        req, params = _parse_submit(engine, name, arr)
+                        req, params = _parse_submit(
+                            engine, name, arr,
+                            stager=self.server.kv_stager(create=False))
                         rid = params.get("rid")
                         if rid and self.server.register_rid(str(rid),
                                                             req):
@@ -283,7 +315,20 @@ class _ServeHandler(socketserver.BaseRequestHandler):
                             if op == OP_SUBMIT:
                                 toks = req.result(timeout=float(
                                     params.get("timeout", 300.0)))
-                                reply = _encode(0, str(req.id), toks)
+                                if params.get("ship_to"):
+                                    # disagg prefill leg: ship the
+                                    # parked KV AFTER the request
+                                    # finished, report the outcome in
+                                    # the reply name (the router's
+                                    # prefill_ship reads it; plain
+                                    # clients never set ship_to)
+                                    info = self.server.ship_kv(
+                                        req, params)
+                                    reply = _encode(
+                                        0, json.dumps(info), toks)
+                                else:
+                                    reply = _encode(0, str(req.id),
+                                                    toks)
                             else:
                                 if not self._stream(engine, sock, req):
                                     return
@@ -335,6 +380,12 @@ class _ServeHandler(socketserver.BaseRequestHandler):
                              # (docs/observability.md)
                              "metrics": engine.metrics.registry.snapshot()})
                         reply = _encode(0, "", None, payload.encode())
+                    elif op == OP_KV_BLOCKS:
+                        # disagg decode leg: one shipped KV block into
+                        # the stager (serving/disagg/ship.py owns the
+                        # sequence/digest/geometry verification)
+                        reply = self.server.kv_stager().handle(
+                            name, payload_in)
                     elif op == OP_PING:
                         reply = _encode(0, "", None)
                     else:
@@ -378,6 +429,11 @@ class ServeFrontend(socketserver.ThreadingTCPServer):
         # request reusing the rid at admission
         self._rid_done: "collections.OrderedDict[str, None]" = \
             collections.OrderedDict()
+        # disagg KV stager (decode replicas; serving/disagg/ship.py) —
+        # built lazily on the first OP_KV_BLOCKS frame, because only
+        # paged engines can stage and most frontends never receive one
+        self._kv_stager = None
+        self._kv_stager_lock = threading.Lock()
         # colocated fast path (docs/wire.md "Transports"): advertise a
         # UDS + shm rendezvous next to the TCP port, served by the SAME
         # handler over the same engine, unless pinned to TCP
@@ -396,6 +452,50 @@ class ServeFrontend(socketserver.ThreadingTCPServer):
                     "serve frontend: local transport endpoints "
                     "unavailable (%s); serving TCP only", e)
         engine.start()
+
+    # ------------------------------------------------- disagg KV ship
+
+    def kv_stager(self, create: bool = True):
+        """The engine's KV stager (decode side of a disagg ship).
+        ``create=False`` returns None until the first OP_KV_BLOCKS
+        frame built it — the submit path's claim probe must not pay a
+        stager on frontends that never receive ships.  Raises typed on
+        a dense engine: there is no block pool to stage into."""
+        with self._kv_stager_lock:
+            if self._kv_stager is None and create:
+                from .disagg.ship import KVShipGeometryError, KVStager
+
+                if not self.engine.paged:
+                    raise KVShipGeometryError(
+                        "this replica's engine is dense (paged=False) "
+                        "— it cannot stage shipped KV blocks")
+                self._kv_stager = KVStager(self.engine)
+            return self._kv_stager
+
+    def ship_kv(self, req: Request, params: dict) -> dict:
+        """Prefill leg: ship ``req``'s parked KV to the decode replica
+        named by ``ship_to``.  Never raises — every failure downgrades
+        to ``{"shipped": False, "error": ...}`` alongside the (valid)
+        token reply, and the router re-prefills decode-side."""
+        from .disagg.ship import KVShipError, ship_parked
+
+        parked = self.engine.take_parked_kv(req.id)
+        if parked is None:
+            return {"shipped": False,
+                    "error": "no parked KV (dense engine, non-DONE "
+                             "finish, or parked-cap eviction)"}
+        try:
+            return ship_parked(
+                self.engine, str(params["ship_to"]),
+                str(params.get("kv_ship", req.id)), parked,
+                metrics=self.engine.metrics)
+        except KVShipError as e:
+            bps_log.warning("disagg ship for request %d failed: %s",
+                            req.id, e)
+            return {"shipped": False,
+                    "error": f"{type(e).__name__}: {e}"}
+        finally:
+            self.engine.release_kv_ids(parked["ids"])
 
     # ------------------------------------------------ OP_CANCEL registry
 
@@ -684,42 +784,77 @@ class RemoteServeClient:
             return self._read_frame()
 
     @staticmethod
-    def _extra(epoch, rid, tenant) -> Optional[dict]:
-        if epoch is None and rid is None and tenant is None:
-            return None
-        return {"epoch": epoch, "rid": rid, "tenant": tenant}
+    def _extra(epoch, rid, tenant, extra=None) -> Optional[dict]:
+        out = dict(extra) if extra else {}
+        if epoch is not None:
+            out["epoch"] = epoch
+        if rid is not None:
+            out["rid"] = rid
+        if tenant is not None:
+            out["tenant"] = tenant
+        return out or None
 
     def generate(self, prompt, max_new_tokens: int, *, seed: int = 0,
                  priority: int = 0, resume=None, epoch=None, rid=None,
-                 tenant=None) -> np.ndarray:
+                 tenant=None, extra=None) -> np.ndarray:
         """Blocking submit -> the full token array.  Raises the typed
         :class:`ServeConnectionError` when the frontend dies first
         (after the deadline-bounded failover loop, on a multi-router
-        client)."""
+        client).  ``extra`` = additional wire params merged into the
+        submit frame (the router's disagg ``kv_ship`` hand-off rides
+        here — docs/serving.md "Disaggregated tiers")."""
         if len(self._addrs) == 1:
             return self._generate_once(prompt, max_new_tokens,
                                        seed=seed, priority=priority,
                                        resume=resume, epoch=epoch,
-                                       rid=rid, tenant=tenant)
+                                       rid=rid, tenant=tenant,
+                                       extra=extra)
         deadline = time.monotonic() + self.timeout
         while True:
             try:
                 return self._generate_once(
                     prompt, max_new_tokens, seed=seed,
                     priority=priority, resume=resume, epoch=epoch,
-                    rid=rid, tenant=tenant)
+                    rid=rid, tenant=tenant, extra=extra)
             except (ServeConnectionError, ServeReplyError) as e:
                 self._note_failover(e, deadline)
 
     def _generate_once(self, prompt, max_new_tokens: int, *, seed, priority,
-                       resume, epoch, rid, tenant) -> np.ndarray:
+                       resume, epoch, rid, tenant,
+                       extra=None) -> np.ndarray:
         with self._lock:
             self._check_usable()
             self._send(_submit_frame(OP_SUBMIT, prompt, max_new_tokens,
                                      seed, priority, resume,
-                                     self._extra(epoch, rid, tenant)))
+                                     self._extra(epoch, rid, tenant,
+                                                 extra)))
             _, out, _ = self._read_frame()
         return np.array(out)
+
+    def prefill_ship(self, prompt, *, seed: int = 0, priority: int = 0,
+                     ship_to: str, kv_ship: str, epoch=None, rid=None,
+                     tenant=None):
+        """The router's disagg prefill leg (docs/serving.md
+        "Disaggregated tiers"): submit the prompt with
+        ``max_new_tokens=1`` and ``ship_to``/``kv_ship`` wire params —
+        the frontend prefills, parks the finished KV, ships it to
+        ``ship_to`` under key ``kv_ship``, and replies with the first
+        token plus a ship report.  Returns ``(tokens, info)`` where
+        ``info`` is the report dict (``{"shipped": bool, ...}``; a
+        failed ship is a DOWNGRADE — the tokens are still valid, the
+        decode side just re-prefills)."""
+        with self._lock:
+            self._check_usable()
+            self._send(_submit_frame(
+                OP_SUBMIT, prompt, 1, seed, priority, None,
+                self._extra(epoch, rid, tenant,
+                            {"ship_to": str(ship_to),
+                             "kv_ship": str(kv_ship)})))
+            rname, out, _ = self._read_frame()
+        info = (json.loads(rname)
+                if rname.startswith("{") else {"shipped": False,
+                                               "error": "no ship report"})
+        return np.array(out), info
 
     def _note_failover(self, e: BaseException,
                        deadline: float) -> BaseException:
@@ -741,7 +876,7 @@ class RemoteServeClient:
 
     def stream(self, prompt, max_new_tokens: int, *, seed: int = 0,
                priority: int = 0, resume=None, epoch=None, rid=None,
-               tenant=None):
+               tenant=None, extra=None):
         """Token iterator over the OP_STREAM wire op: yields each token
         as its frame arrives (``resume`` = already-emitted tokens for a
         failover re-dispatch — only NEW tokens are streamed back).  A
@@ -763,14 +898,15 @@ class RemoteServeClient:
             return self._stream_once(prompt, max_new_tokens, seed=seed,
                                      priority=priority, resume=resume,
                                      epoch=epoch, rid=rid,
-                                     tenant=tenant)
+                                     tenant=tenant, extra=extra)
         return self._stream_failover(prompt, max_new_tokens, seed=seed,
                                      priority=priority, resume=resume,
                                      epoch=epoch, rid=rid,
-                                     tenant=tenant)
+                                     tenant=tenant, extra=extra)
 
     def _stream_failover(self, prompt, max_new_tokens: int, *, seed,
-                         priority, resume, epoch, rid, tenant):
+                         priority, resume, epoch, rid, tenant,
+                         extra=None):
         emitted: List[int] = ([int(t) for t in resume]
                               if resume is not None else [])
         deadline = time.monotonic() + self.timeout
@@ -779,7 +915,8 @@ class RemoteServeClient:
                 for tok in self._stream_once(
                         prompt, max_new_tokens, seed=seed,
                         priority=priority, resume=emitted or None,
-                        epoch=epoch, rid=rid, tenant=tenant):
+                        epoch=epoch, rid=rid, tenant=tenant,
+                        extra=extra):
                     emitted.append(int(tok))
                     # the failover budget is timeout WITHOUT PROGRESS:
                     # a healthy stream longer than self.timeout must
@@ -798,7 +935,7 @@ class RemoteServeClient:
                 self._note_failover(e, deadline)
 
     def _stream_once(self, prompt, max_new_tokens: int, *, seed,
-                     priority, resume, epoch, rid, tenant):
+                     priority, resume, epoch, rid, tenant, extra=None):
         with self._lock:
             self._check_usable()
             in_flight = False
@@ -811,7 +948,7 @@ class RemoteServeClient:
                                          max_new_tokens, seed,
                                          priority, resume,
                                          self._extra(epoch, rid,
-                                                     tenant)))
+                                                     tenant, extra)))
                 in_flight = True
                 while True:
                     try:

@@ -84,6 +84,14 @@ TTFT_S = "serve.ttft_s"
 TPOT_S = "serve.tpot_s"
 # live credit level of the prefill scheduler (padded tokens remaining)
 PREFILL_CREDITS = "serve.prefill_credits"
+# disaggregated prefill/decode (serving/disagg): KV blocks shipped from
+# a prefill replica to its decode target over OP_KV_BLOCKS, the wire
+# bytes they carried (payload only — framing overhead excluded so the
+# counter divides into block_bytes exactly), and the per-request ship
+# latency (park -> last ack, seconds) as a reservoir histogram
+KV_BLOCKS_SHIPPED = "serve.kv_blocks_shipped"
+KV_BLOCKS_SHIPPED_BYTES = "serve.kv_blocks_shipped_bytes"
+SHIP_LATENCY_S = "serve.ship_latency_s"
 
 
 class ServeMetrics:
@@ -94,7 +102,8 @@ class ServeMetrics:
     ``get_serve_metrics()`` singleton binds the process-global registry
     so scrapes see the serving engine live."""
 
-    _HIST = {"queue_wait": QUEUE_WAIT_S, "ttft": TTFT_S, "tpot": TPOT_S}
+    _HIST = {"queue_wait": QUEUE_WAIT_S, "ttft": TTFT_S, "tpot": TPOT_S,
+             "ship": SHIP_LATENCY_S}
 
     def __init__(self, tracer=None,
                  registry: Optional[MetricsRegistry] = None):
@@ -164,7 +173,7 @@ class ServeMetrics:
     def summary(self) -> Dict[str, object]:
         """Counters plus latency percentiles (seconds)."""
         out: Dict[str, object] = dict(self.snapshot())
-        for label in ("queue_wait", "ttft", "tpot"):
+        for label in ("queue_wait", "ttft", "tpot", "ship"):
             h = self._hist(label)
             out[f"{label}_p50_s"] = h.percentile(50)
             out[f"{label}_p99_s"] = h.percentile(99)

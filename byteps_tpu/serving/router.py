@@ -163,6 +163,14 @@ CANCELS = "router.cancels"
 CANCELLED = "router.requests_cancelled"
 # --- per-tenant fair share (labeled gauge: credits remaining)
 TENANT_CREDITS = "router.tenant_credits"
+# --- disaggregated prefill/decode (docs/serving.md "Disaggregated
+# tiers"): prefill legs dispatched to the prefill tier, KV blocks
+# their ships delivered, and legs that fell back to decode-side
+# re-prefill (failed/partial ship or a dead prefill replica — the
+# availability floor is the colocated path)
+DISAGG_PREFILLS = "router.disagg_prefills"
+DISAGG_SHIPPED_BLOCKS = "router.disagg_shipped_blocks"
+DISAGG_FALLBACKS = "router.disagg_fallbacks"
 
 
 class ReplicaState(enum.Enum):
@@ -209,12 +217,17 @@ class WeightsMismatchError(RuntimeError):
 
 
 class _Replica:
-    __slots__ = ("idx", "addr", "inflight", "suspect", "dead",
+    __slots__ = ("idx", "addr", "role", "inflight", "suspect", "dead",
                  "draining", "retired", "refused", "verified")
 
-    def __init__(self, idx: int, addr: str):
+    def __init__(self, idx: int, addr: str, role: str = "both"):
         self.idx = idx
         self.addr = addr
+        # serving role (docs/serving.md "Disaggregated tiers"):
+        # "prefill" replicas only ever receive prefill+ship legs,
+        # "decode" / "both" replicas take normal placement ("both"
+        # additionally runs its own prefill — the colocated default)
+        self.role = role
         self.inflight = 0
         self.suspect = False
         self.dead = False
@@ -271,12 +284,39 @@ class ServeRouter:
                  self_addr: str = "",
                  epoch_timeout: float = 0.5,
                  tenant_weights: Optional[Dict[str, float]] = None,
-                 journal_every: int = 8):
+                 journal_every: int = 8,
+                 roles: Optional[Sequence[str]] = None,
+                 disagg: bool = True):
         if not replicas:
             raise ValueError(
                 "ServeRouter needs at least one replica address "
                 "(BYTEPS_ROUTER_REPLICAS=host:port,host:port)")
         self._replicas = [_Replica(i, a) for i, a in enumerate(replicas)]
+        # ---- disaggregated tiers (docs/serving.md) -------------------
+        # ``roles`` mirrors ``replicas`` positionally (BYTEPS_ROUTER_
+        # ROLES=prefill,decode,...).  Omitted/empty = every replica is
+        # "both" (colocated — today's behaviour, bit for bit).
+        if roles:
+            roles = [str(x).strip() for x in roles]
+            if len(roles) != len(self._replicas):
+                raise ValueError(
+                    f"roles has {len(roles)} entries for "
+                    f"{len(self._replicas)} replicas (BYTEPS_ROUTER_ROLES "
+                    f"must mirror BYTEPS_ROUTER_REPLICAS positionally)")
+            for r, role in zip(self._replicas, roles):
+                if role not in ("prefill", "decode", "both"):
+                    raise ValueError(
+                        f"unknown replica role {role!r} (want prefill, "
+                        f"decode, or both)")
+                r.role = role
+            if all(r.role == "prefill" for r in self._replicas):
+                raise ValueError(
+                    "every replica is prefill-role: at least one decode "
+                    "or both replica must exist to run decode")
+        # disaggregation is live only when the operator actually split
+        # the pool; the flag (BYTEPS_DISAGG=0) force-colocates even then
+        self._disagg = bool(disagg) and any(
+            r.role == "prefill" for r in self._replicas)
         self.credits = max(1, credits)
         self.affinity = bool(affinity)
         self.affinity_block = max(1, affinity_block)
@@ -516,7 +556,7 @@ class ServeRouter:
             ents.extend(
                 {"k": "inflight",
                  **{f: rec[f] for f in ("rid", "seed", "prio", "mnt",
-                                        "tenant", "r", "n")}}
+                                        "tenant", "r", "n", "st")}}
                 for rec in self._inflight.values()
                 if rec.get("r") is not None)
             for ent in ents:
@@ -798,11 +838,12 @@ class ServeRouter:
 
     def _gauge_state(self, r: _Replica) -> None:
         self._registry.gauge(REPLICA_STATE, track="router",
-                             replica=r.idx).set(_STATE_GAUGE[r.state])
+                             replica=r.idx, role=r.role
+                             ).set(_STATE_GAUGE[r.state])
 
     def _gauge_inflight(self, r: _Replica) -> None:
         self._registry.gauge(REPLICA_INFLIGHT, track="router",
-                             replica=r.idx).set(r.inflight)
+                             replica=r.idx, role=r.role).set(r.inflight)
 
     # --------------------------------------------------------------- health
 
@@ -996,7 +1037,9 @@ class ServeRouter:
             preferred_full = False
             for idx in cands:
                 r = self._replicas[idx]
-                if idx in tried or not r.placeable:
+                # prefill-role replicas never take normal placement:
+                # they only ever see the prefill+ship leg
+                if idx in tried or not r.placeable or r.role == "prefill":
                     continue
                 if r.inflight >= self.credits:
                     if idx == preferred:
@@ -1036,6 +1079,63 @@ class ServeRouter:
             r.inflight -= 1
             self._gauge_inflight(r)
             self._cv.notify_all()
+
+    def _acquire_prefill(self, tried: Set[int]) -> Optional[_Replica]:
+        """Queue-depth placement for the prefill leg: the prefill-role
+        replica with the fewest in-flight legs (every dispatch flows
+        through the router, so ``inflight`` IS the queue depth) that
+        still has a credit.  Prefill has no prefix affinity — there is
+        no warm cache to return to; the leg's KV leaves with the ship.
+        ``None`` = no prefill capacity right now (the caller falls
+        back to colocated decode-side prefill, never queues)."""
+        with self._lock:
+            best = None
+            for r in self._replicas:
+                if (r.role != "prefill" or r.idx in tried
+                        or not r.placeable
+                        or r.inflight >= self.credits):
+                    continue
+                if best is None or r.inflight < best.inflight:
+                    best = r
+            if best is None:
+                return None
+            best.inflight += 1
+            self._gauge_inflight(best)
+            return best
+
+    def _peek_decode(self, digest: bytes) -> Optional[_Replica]:
+        """The decode replica normal placement would choose for this
+        prefix group — WITHOUT taking a credit (the ship needs a
+        destination address at prefill time; the decode dispatch takes
+        the credit itself moments later).  Pins the affinity map so the
+        later :meth:`_acquire` lands on the same replica the blocks
+        were shipped to; a divergence (the replica died or filled in
+        between) only strands the staging for the TTL sweep — the
+        decode leg then re-prefills, it never attends foreign KV."""
+        with self._lock:
+            n = len(self._replicas)
+            mapped = (self._affinity_map.get(digest)
+                      if self.affinity else None)
+            if self.affinity:
+                order = self._hrw_order(digest)
+                if mapped is not None:
+                    order = [mapped] + [i for i in order if i != mapped]
+            else:
+                start = next(self._rr) % n
+                order = [(start + j) % n for j in range(n)]
+            for idx in order:
+                r = self._replicas[idx]
+                if not r.placeable or r.role == "prefill":
+                    continue
+                if self.affinity and mapped != idx:
+                    self._affinity_map[digest] = idx
+                    self._jpub(k="affinity", d=digest.hex(), r=idx)
+                    while len(self._affinity_map) > self._affinity_cap:
+                        self._affinity_map.popitem(last=False)
+                if self.affinity and digest in self._affinity_map:
+                    self._affinity_map.move_to_end(digest)
+                return r
+            return None
 
     # ------------------------------------------------------------- dispatch
 
@@ -1083,7 +1183,11 @@ class ServeRouter:
         rid = str(rid) if rid else f"r{self._self_idx}.{next(self._rid_seq)}"
         rec = {"rid": rid, "seed": int(seed), "prio": int(priority),
                "mnt": int(max_new_tokens), "tenant": tenant,
-               "r": None, "n": len(emitted), "cancelled": False}
+               "r": None, "n": len(emitted), "cancelled": False,
+               # dispatch stage, journaled to standbys: None (normal)
+               # or "ship" (PREFILL_SHIPPING — a takeover knows the
+               # request was mid-prefill-leg and owns no decode slot)
+               "st": None}
         with self._lock:
             if rid in self._cancel_tombs:
                 del self._cancel_tombs[rid]
@@ -1116,7 +1220,7 @@ class ServeRouter:
             self._jpub(k="inflight",
                        **{f: rec[f] for f in ("rid", "seed", "prio",
                                               "mnt", "tenant", "r",
-                                              "n")})
+                                              "n", "st")})
 
         tname = (tenant if tenant in self._tenant_pools else "default")
         pool = self._tenant_pools.get(tname)
@@ -1143,6 +1247,114 @@ class ServeRouter:
                         break
                 debited = True
                 self._gauge_tenant(tname)
+            # ---- disaggregated prefill leg (docs/serving.md) ---------
+            # One-shot: run the prompt on a prefill-role replica with
+            # mnt=1 and ship_to=<the decode replica placement would
+            # pick>; the prefill frontend parks the finished KV and
+            # ships it to the decode target before replying.  ANY
+            # failure on this leg falls through to the normal loop —
+            # decode-side re-prefill over the resume path (PR 10) is
+            # the availability floor: disaggregation is never less
+            # available than colocated serving.
+            ship_addr: Optional[str] = None
+            ship_first = False  # first decode leg after a prefill leg
+            if self._disagg and not emitted and not rec["cancelled"]:
+                d = self._peek_decode(digest)
+                p = (self._acquire_prefill(tried)
+                     if d is not None else None)
+                if (p is not None and not p.verified
+                        and not self._verify_replica_weights(
+                            p, raising=False)):
+                    self._release(p)
+                    p = None
+                if p is not None:
+                    pleg: Optional[RemoteServeClient] = None
+                    try:
+                        dispatched = True
+                        rec["r"] = p.idx
+                        rec["st"] = "ship"  # PREFILL_SHIPPING
+                        _jpub_inflight()
+                        pleg = RemoteServeClient(
+                            p.addr, timeout=self.stream_timeout)
+                        toks, info = pleg.prefill_ship(
+                            prompt, seed=seed, priority=priority,
+                            ship_to=d.addr, kv_ship=rid,
+                            epoch=self.epoch, rid=rid, tenant=tenant)
+                        self._bump(DISAGG_PREFILLS)
+                        # name the staging on the decode dispatch
+                        # either way: a complete ship is adopted, a
+                        # failed/partial one is aborted and released
+                        # promptly instead of waiting out the TTL
+                        ship_addr = d.addr
+                        ship_first = True
+                        if info.get("shipped"):
+                            self._bump(DISAGG_SHIPPED_BLOCKS,
+                                       int(info.get("blocks", 0)))
+                        else:
+                            self._bump(DISAGG_FALLBACKS)
+                            bps_log.warning(
+                                "disagg: ship %s -> %s failed (%s); "
+                                "decode-side re-prefill",
+                                rid, d.addr, info.get("error"))
+                        for tok in toks:
+                            if rec["cancelled"]:
+                                self._bump(CANCELLED)
+                                return
+                            emitted.append(int(tok))
+                            rec["n"] = len(emitted)
+                            yield int(tok)
+                        if len(emitted) >= max_new_tokens:
+                            # mnt=1 request: the prefill leg WAS the
+                            # whole request (its staging, if any, is
+                            # TTL-swept at the decode replica)
+                            self._bump(COMPLETED)
+                            return
+                    except (ServeConnectionError, OSError) as e:
+                        # the prefill replica died mid-leg or mid-ship:
+                        # fall through — the loop below re-prefills
+                        # decode-side from scratch (no tokens were
+                        # emitted, so the prefix is just the prompt)
+                        self._note_leg_failure(p)
+                        self._bump(FAILOVERS)
+                        self._bump(DISAGG_FALLBACKS)
+                        if rec["cancelled"]:
+                            self._bump(CANCELLED)
+                            return
+                        bps_log.warning(
+                            "disagg: prefill replica %d (%s) lost "
+                            "mid-leg (%s); decode-side re-prefill",
+                            p.idx, p.addr, e)
+                    except RuntimeError as e:
+                        msg = str(e)
+                        if "EpochFencedError" in msg:
+                            m = re.search(r"high-water (\d+)", msg)
+                            self._demote(int(m.group(1)) if m
+                                         else self.epoch)
+                            self._bump(STANDBY_REFUSED)
+                            raise RouterStandbyError(
+                                f"router "
+                                f"{self.self_addr or self._self_idx} "
+                                f"deposed: replica {p.idx} fenced "
+                                f"epoch {self.epoch}; retry the "
+                                f"active router with resume") from e
+                        if "ValueError" in msg:
+                            # deterministic client error: recurs on
+                            # every replica — propagate, don't retry
+                            self._bump(FAILED)
+                            raise
+                        # backpressure / engine failure on the prefill
+                        # tier: colocated fallback, not a request
+                        # failure
+                        self._bump(DISAGG_FALLBACKS)
+                        if rec["cancelled"]:
+                            self._bump(CANCELLED)
+                            return
+                    finally:
+                        if pleg is not None:
+                            pleg.close()
+                        self._release(p)
+                        rec["r"] = None
+                        rec["st"] = None
             while True:
                 if rec["cancelled"]:
                     self._bump(CANCELLED)
@@ -1198,10 +1410,11 @@ class ServeRouter:
                 try:
                     leg = RemoteServeClient(r.addr,
                                             timeout=self.stream_timeout)
-                    if emitted and dispatched:
+                    if emitted and dispatched and not ship_first:
                         # a router-internal re-dispatch (mid-stream
                         # failover) — caller-supplied resume tokens on
-                        # the FIRST leg are not one
+                        # the FIRST leg are not one, and neither is the
+                        # decode leg that follows a prefill leg
                         self._bump(REDISPATCHES)
                     dispatched = True
                     rec["r"] = r.idx
@@ -1216,10 +1429,20 @@ class ServeRouter:
                     # replica, emitted COUNT (counts, not tokens — the
                     # client holds the tokens)
                     _jpub_inflight()
+                    extra = None
+                    if (ship_addr is not None and r.addr == ship_addr
+                            and len(emitted) == 1):
+                        # name the staged ship on the decode dispatch
+                        # (consumed once: the frontend's stager.take
+                        # pops the staging, adopted or aborted)
+                        extra = {"kv_ship": rid}
+                        ship_addr = None
+                    ship_first = False
                     for tok in leg.stream(prompt, max_new_tokens,
                                           seed=seed, priority=priority,
                                           resume=emitted or None,
-                                          epoch=self.epoch, rid=rid):
+                                          epoch=self.epoch, rid=rid,
+                                          extra=extra):
                         if rec["cancelled"]:
                             # a cancel whose replica-side forward
                             # missed this leg (raced a re-dispatch, or
@@ -1385,10 +1608,12 @@ class ServeRouter:
         # lint (lock-unguarded-field) flagged here
         with self._lock:
             reps = [{"addr": r.addr, "state": r.state.value,
-                     "inflight": r.inflight} for r in self._replicas]
+                     "inflight": r.inflight, "role": r.role}
+                    for r in self._replicas]
             out: Dict[str, object] = {"replicas": reps,
                                       "affinity": self.affinity,
                                       "credits": self.credits,
+                                      "disagg": self._disagg,
                                       "role": ("active" if self._active
                                                else "standby"),
                                       "epoch": self.epoch,
@@ -1404,7 +1629,8 @@ class ServeRouter:
                      AFFINITY_MISSES, DRAINS, WEIGHTS_REFUSED,
                      TAKEOVERS, DEMOTIONS, STANDBY_REFUSED, CANCELS,
                      CANCELLED, JOURNAL_SENT, JOURNAL_APPLIED,
-                     TAKEOVER_ORPHANS):
+                     TAKEOVER_ORPHANS, DISAGG_PREFILLS,
+                     DISAGG_SHIPPED_BLOCKS, DISAGG_FALLBACKS):
             m = self._registry.get(name)
             out[name] = m.value if m is not None else 0
         return out
@@ -1627,8 +1853,18 @@ def router_from_env(env=None) -> int:
                     f"byteps_tpu.launcher: BYTEPS_ROUTER_TENANT_WEIGHTS "
                     f"weight for {t.strip()!r} must be a number, got "
                     f"{w.strip()!r}") from None
+    roles = [x.strip() for x in cfg.router_roles.split(",")
+             if x.strip()]
+    if roles and len(roles) != len(replicas):
+        raise SystemExit(
+            f"byteps_tpu.launcher: BYTEPS_ROUTER_ROLES has "
+            f"{len(roles)} entries for {len(replicas)} replicas — it "
+            f"must mirror BYTEPS_ROUTER_REPLICAS positionally "
+            f"(prefill, decode, or both)")
     router = ServeRouter(
         replicas,
+        roles=roles or None,
+        disagg=cfg.disagg,
         credits=cfg.router_credits,
         affinity=cfg.router_affinity,
         affinity_block=cfg.router_affinity_block,

@@ -40,11 +40,22 @@ Legs:
     dispatch stamped with the dead router's epoch is refused typed
     (``EpochFencedError``) by a replica that served the new epoch.
 
+  * **prefill kill** (``--kill-prefill-at N`` — docs/serving.md
+    "Disaggregated tiers"): a prefill-role + decode-role pair behind a
+    role-aware router.  The victim's KV ship is cut deterministically
+    after exactly N shipped blocks AND the prefill replica is
+    hard-killed at that instant (crash semantics).  The decode replica
+    must never attend the torn ship: the victim completes
+    token-identically through the decode-side re-prefill fallback,
+    follow-up traffic keeps completing colocated on the survivor, and
+    nothing hangs.
+
 Usage:
     python scripts/router_chaos.py [--requests 12] [--temperature 0.8]
                                    [--fault-rate 0.12] [--no-kill]
                                    [--no-drain] [--seed 0]
                                    [--kill-router-at N]
+                                   [--kill-prefill-at N]
 
 Wired into CI as a ``slow``-marked pytest (tests/test_router_chaos.py)
 with a fast deterministic single-failover sibling in tier-1
@@ -520,6 +531,168 @@ def run_router_kill(requests: int = 10, seed: int = 0,
                 pass
 
 
+def run_prefill_kill(requests: int = 8, seed: int = 0,
+                     temperature: float = 0.0, kill_blocks: int = 2,
+                     verbose: bool = True,
+                     lockcheck: bool = False) -> dict:
+    """The ``--kill-prefill-at N`` leg (docs/serving.md "Disaggregated
+    tiers"): a prefill-role replica crashes after shipping EXACTLY N KV
+    blocks of the victim's prefill.  The kill is deterministic — the
+    ship sender's ``on_block_sent`` chaos hook counts acked blocks,
+    hard-kills the prefill frontend at N, and raises the same
+    ``ConnectionError`` a cut wire would.  The contract: the victim
+    completes token-identically through the decode-side re-prefill
+    fallback (never attends the torn ship), follow-up traffic keeps
+    completing on the surviving decode replica (disaggregation is
+    never less available than colocated), zero hangs."""
+    import jax
+    import jax.numpy as jnp
+
+    lockrt = _maybe_lockcheck(lockcheck)
+
+    from byteps_tpu.inference import generate
+    from byteps_tpu.models.transformer import (Transformer,
+                                               TransformerConfig)
+    from byteps_tpu.observability.metrics import MetricsRegistry
+    from byteps_tpu.resilience.policy import RetryPolicy
+    from byteps_tpu.serving import ServeMetrics, ServeRouter, ServingEngine
+    from byteps_tpu.serving import router as rt
+    from byteps_tpu.serving.disagg import ship as dship
+    from byteps_tpu.serving.frontend import serve
+
+    cfg = TransformerConfig(vocab_size=61, num_layers=2, num_heads=2,
+                            d_model=32, d_ff=64, max_seq_len=96,
+                            dtype=jnp.float32)
+    model = Transformer(cfg)
+    variables = model.init(jax.random.PRNGKey(1),
+                           jnp.zeros((1, 8), jnp.int32))
+    rng = random.Random(seed)
+    jobs = []
+    for i in range(requests):
+        if i == 0:
+            T, M = 40, 8  # the victim: a 5-block prompt (block=8)
+        else:
+            T, M = rng.randint(3, 16), rng.randint(2, 8)
+        prompt = np.asarray(jax.random.randint(
+            jax.random.PRNGKey(4000 + i), (T,), 0, 61), np.int32)
+        jobs.append((prompt, M, 5000 + i))
+    refs = []
+    for prompt, M, s in jobs:
+        kw = ({"rng": jax.random.PRNGKey(s)} if temperature else {})
+        refs.append(list(np.asarray(generate(
+            model, variables, prompt[None], M, temperature=temperature,
+            **kw)["tokens"])[0]))
+
+    engines = [ServingEngine(model, variables, n_slots=4, max_seq=96,
+                             temperature=temperature, paged=True,
+                             block=8, chunk=16, metrics=ServeMetrics())
+               for _ in range(2)]
+    srvs = [serve(e, 0, host="127.0.0.1", in_thread=True)[0]
+            for e in engines]
+    addrs = ["127.0.0.1:%d" % s.server_address[1] for s in srvs]
+    deadline = 60.0
+    router = ServeRouter(
+        addrs, roles=["prefill", "decode"], affinity=True,
+        affinity_block=16, credits=4, deadline=deadline,
+        stream_timeout=10.0, heartbeat_interval=0.2, miss_threshold=3,
+        ping_timeout=1.0,
+        retry=RetryPolicy(max_attempts=8, backoff_base=0.05,
+                          backoff_mult=2.0, backoff_cap=0.5,
+                          jitter=0.2, deadline=0.0),
+        registry=MetricsRegistry()).start()
+
+    shipped = [0]
+
+    def hook(key, i, n):
+        shipped[0] += 1
+        if shipped[0] == kill_blocks:
+            if verbose:
+                print(f"killing prefill replica after exactly "
+                      f"{kill_blocks} shipped blocks (of {n})",
+                      flush=True)
+            srvs[0].kill()  # a crashed process, not a graceful close
+            raise ConnectionError(
+                "chaos: prefill replica killed mid-ship")
+
+    outcomes = [None] * requests
+    durations = [0.0] * requests
+
+    def submit_one(i):
+        prompt, M, s = jobs[i]
+        t0 = time.monotonic()
+        try:
+            toks = list(router.stream(prompt, M, seed=s))
+            outcomes[i] = "ok" if toks == refs[i] else "mismatch"
+        except Exception as e:  # anything here is a bug: the decode
+            outcomes[i] = f"UNTYPED:{type(e).__name__}: {e}"
+        durations[i] = time.monotonic() - t0
+
+    try:
+        dship.on_block_sent = hook
+        # the victim runs alone so ITS ship is deterministically the
+        # one the hook cuts at block N
+        submit_one(0)
+        assert shipped[0] == kill_blocks, (
+            f"hook fired at {shipped[0]} blocks, wanted {kill_blocks}")
+        dship.on_block_sent = None
+        # follow-up traffic: the prefill tier is dead, every request
+        # must still complete colocated on the decode replica
+        threads = []
+        for i in range(1, requests):
+            t = threading.Thread(target=submit_one, args=(i,),
+                                 daemon=True)
+            threads.append(t)
+            t.start()
+            time.sleep(rng.uniform(0.0, 0.03))
+        hangs = 0
+        join_deadline = time.monotonic() + deadline + 30.0
+        for t in threads:
+            t.join(max(0.1, join_deadline - time.monotonic()))
+            hangs += int(t.is_alive())
+
+        st = router.stats()
+        stats = {
+            "requests": requests,
+            "completed": sum(o == "ok" for o in outcomes),
+            "mismatches": sum(o == "mismatch" for o in outcomes),
+            "untyped_failures": sum(
+                o is not None and str(o).startswith("UNTYPED")
+                for o in outcomes),
+            "hangs": hangs,
+            "max_duration_s": max(durations),
+            "shipped_before_kill": shipped[0],
+            "disagg_fallbacks": st[rt.DISAGG_FALLBACKS],
+            "disagg_prefills": st[rt.DISAGG_PREFILLS],
+            "failovers": st[rt.FAILOVERS],
+        }
+        if verbose:
+            print(stats, flush=True)
+        # the acceptance contract (ISSUE 17): a prefill replica dying
+        # after exactly N shipped blocks must not change a single token
+        # — the victim re-prefills decode-side, nothing attends the
+        # torn ship, and the tier stays available with zero hangs
+        assert stats["mismatches"] == 0, outcomes
+        assert stats["untyped_failures"] == 0, outcomes
+        assert stats["hangs"] == 0
+        assert stats["completed"] == requests, outcomes
+        assert outcomes[0] == "ok", outcomes[0]  # the victim fell back
+        assert stats["disagg_fallbacks"] >= 1
+        assert stats["max_duration_s"] < deadline + 30.0
+        if lockrt is not None:
+            stats.update(lockrt.chaos_verdict())
+        return stats
+    finally:
+        dship.on_block_sent = None
+        router.close()
+        for j, s in enumerate(srvs):
+            if j != 0:
+                try:
+                    s.shutdown()
+                    s.server_close()
+                except Exception:
+                    pass
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=12)
@@ -535,11 +708,25 @@ def main(argv=None) -> int:
                          "victim after N frames, kill the ACTIVE "
                          "router there, and prove takeover + epoch "
                          "fencing")
+    ap.add_argument("--kill-prefill-at", type=int, default=0,
+                    metavar="N",
+                    help="run the disaggregation leg instead: kill the "
+                         "prefill-role replica after exactly N shipped "
+                         "KV blocks and prove token-identical "
+                         "completion via decode-side re-prefill "
+                         "(docs/serving.md \"Disaggregated tiers\")")
     ap.add_argument("--lockcheck", action="store_true",
                     help="instrument locks and fail on any lock-order "
                          "cycle (BYTEPS_LOCKCHECK=1 equivalent; "
                          "docs/analysis.md)")
     args = ap.parse_args(argv)
+    if args.kill_prefill_at > 0:
+        run_prefill_kill(requests=args.requests, seed=args.seed,
+                         temperature=args.temperature,
+                         kill_blocks=args.kill_prefill_at,
+                         lockcheck=args.lockcheck)
+        print("router chaos (prefill kill): OK", flush=True)
+        return 0
     if args.kill_router_at > 0:
         run_router_kill(requests=args.requests, seed=args.seed,
                         n_replicas=args.replicas,

@@ -629,13 +629,14 @@ def test_update_baseline_rule_filter_preserves_other_rules(tmp_path):
     root = tmp_path
     (root / "byteps_tpu" / "common").mkdir(parents=True)
     (root / "byteps_tpu" / "engine").mkdir()
-    (root / "byteps_tpu" / "serving").mkdir()
+    (root / "byteps_tpu" / "serving" / "disagg").mkdir(parents=True)
     (root / "docs").mkdir()
     for rel in ("byteps_tpu/common/config.py",
                 "byteps_tpu/engine/ps_server.py",
                 "byteps_tpu/serving/frontend.py",
                 "byteps_tpu/serving/router.py",
                 "byteps_tpu/serving/journal.py",
+                "byteps_tpu/serving/disagg/ship.py",
                 "docs/env.md", "docs/observability.md",
                 "docs/wire.md", "docs/serving.md"):
         (root / rel).write_text("")

@@ -80,9 +80,13 @@ def test_greedy_generate_matches_reference_loop():
         np.asarray(out["tokens"]), np.asarray(jnp.stack(want, axis=1)))
 
 
+@pytest.mark.slow
 def test_generate_windowed_flash_model():
     """Decode applies the config's sliding window: greedy generation from a
-    windowed model matches the naive full-forward loop of the same model."""
+    windowed model matches the naive full-forward loop of the same model.
+    Slow: the windowed flash variant pays its own Pallas compile; the
+    fast flash coverage is test_flash_prefill_matches_dense_cache_path /
+    test_flash_prefill_awkward_lengths_fall_back."""
     cfg, model, tokens, variables = _tiny_model(
         attn_impl="flash", attn_window=8)
     n = 6

@@ -58,9 +58,13 @@ def test_greedy_generation_matches_torch():
     np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.slow
 def test_inference_stack_on_gpt2():
     """Beam search, speculative decoding, int8 quantization, and the KV
-    cache all run on converted GPT-2 weights."""
+    cache all run on converted GPT-2 weights.  Slow: four inference
+    modes x compile on the GPT-2 arch (tier-1 duration budget);
+    test_greedy_generation_matches_torch keeps the fast conversion
+    parity coverage."""
     hf = _hf_model(seed=5)
     model, variables = load_gpt2(hf)
     prompt = jnp.asarray(

@@ -101,6 +101,48 @@ def test_router_chaos_kill_active_router(temperature):
 
 
 @pytest.mark.slow
+@pytest.mark.parametrize("temperature", [0.0, 0.8])
+def test_router_chaos_kill_prefill_mid_ship(temperature):
+    """The disaggregation chaos leg (ISSUE 17 acceptance): the
+    prefill-role replica is hard-killed after EXACTLY N shipped KV
+    blocks of the victim's prefill.  The decode replica must never
+    attend the torn ship: the victim completes token-identically via
+    the decode-side re-prefill fallback (greedy AND seeded), follow-up
+    traffic keeps completing on the survivor, zero hangs."""
+    import router_chaos
+
+    stats = router_chaos.run_prefill_kill(
+        requests=8, seed=0, temperature=temperature, kill_blocks=2,
+        verbose=False)
+    # run_prefill_kill() already asserts the contract; pin the
+    # headline numbers so a silent weakening cannot pass
+    assert stats["mismatches"] == 0
+    assert stats["untyped_failures"] == 0
+    assert stats["hangs"] == 0
+    assert stats["completed"] == 8
+    assert stats["shipped_before_kill"] == 2
+    assert stats["disagg_fallbacks"] >= 1
+
+
+@pytest.mark.slow
+def test_bench_serve_disagg_mixed_no_mismatch(tmp_path):
+    """The disaggregation bench row: the mixed long/short leg completes
+    with ZERO mismatches in both modes, actually ships blocks, and the
+    decode tier's short-request TPOT p99 grows no faster with prompt
+    length than colocated serving (the point of the split)."""
+    import bench_serve
+
+    row = bench_serve.disagg_ab(
+        out_path=str(tmp_path / "BENCH_SERVE.json"))
+    assert row["disagg"]["mismatches"] == 0, row
+    assert row["colocated"]["mismatches"] == 0, row
+    assert row["disagg"]["shipped_blocks"] > 0, row
+    assert row["disagg"]["fallbacks"] == 0, row
+    assert (row["disagg"]["tpot_p99_growth"]
+            <= row["colocated"]["tpot_p99_growth"]), row
+
+
+@pytest.mark.slow
 def test_bench_router_ha_completes_across_router_kill(tmp_path):
     """The router-HA bench row: the router-kill leg completes EVERY
     request token-identical (availability degrades to takeover-window

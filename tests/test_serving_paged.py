@@ -245,8 +245,11 @@ def test_preemption_under_block_pressure_greedy(tiny):
     assert eng.pool.alloc.used_count == 1  # all blocks reclaimed
 
 
+@pytest.mark.slow
 def test_preemption_under_block_pressure_seeded(tiny):
-    """The preempt/resume cycle preserves the per-request sampling key
+    """Slow sibling of the greedy preemption test above (sampling-path
+    compile; tier-1 duration budget).
+    The preempt/resume cycle preserves the per-request sampling key
     chain: the resume prefill's sampled token and key split are
     discarded, the parked token + carried key continue the stream —
     seeded output identical to an unpreempted generate()."""

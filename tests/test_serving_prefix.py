@@ -136,11 +136,15 @@ def test_prefix_cache_eviction_repoints_shared_boundaries():
 # ------------------------------------------------- chunked prefill parity
 
 
+@pytest.mark.slow
 def test_chunked_prefill_greedy_parity_and_trace_counts(tiny):
     """Prompts spanning several chunks (and the sub-chunk short case)
     match generate() bit-for-bit; chunk-prefill traces are bounded by
     distinct chunk buckets (one here: everything pads to the 8 bucket)
-    and nothing retraces on repeats."""
+    and nothing retraces on repeats.  Slow: multi-chunk prefill
+    compile + trace assertions (tier-1 duration budget);
+    test_chunk_budget_bounds_tick_prefill and the prefix-reuse parity
+    tests keep fast chunked-prefill coverage."""
     _, model, variables = tiny
     prompts = [np.asarray(jax.random.randint(
         jax.random.PRNGKey(20 + i), (L,), 0, 61), np.int32)
@@ -331,8 +335,12 @@ def test_tiny_credit_budget_cannot_stall_prefix_resume(tiny):
     assert eng.metrics.get(sm.PREFIX_HITS) == 1
 
 
+@pytest.mark.slow
 def test_shared_store_isolates_different_weights(tiny, shared_prompts):
-    """Two engines serving DIFFERENT weights through one shared
+    """Slow: a second model init + its prefill compiles (tier-1
+    duration budget); test_prefix_cache_store_mechanics keeps the fast
+    store-keying coverage.
+    Two engines serving DIFFERENT weights through one shared
     PrefixCache must never exchange K/V: the weights-fingerprint salt
     keys their prefixes apart, so engine B misses on the prompt engine
     A cached (and still matches its own generate() exactly), while a

@@ -184,10 +184,14 @@ def test_router_failover_mid_stream_greedy(tiny, prompts, greedy_base,
         proxy.close()
 
 
+@pytest.mark.slow
 def test_router_failover_mid_stream_seeded(tiny, prompts):
     """Seeded sampling across a mid-stream replica death: the carried
     key is recomputed as the k-fold split chain of PRNGKey(seed), so
-    the resumed stream continues the exact sample path."""
+    the resumed stream continues the exact sample path.  Slow:
+    sampling-path compile on two disposable replicas (tier-1 duration
+    budget); the greedy anchor above stays fast and the seeded leg is
+    chaos-pinned in tests/test_router_chaos.py."""
     _, model, variables = tiny
     p = prompts[1]
     want = np.asarray(generate(model, variables, p[None], M,
@@ -978,12 +982,39 @@ def test_wire_cancel_reclaims_blocks_through_router(tiny, prompts):
         srv.server_close()
 
 
+def test_router_tenant_fair_share_pools_and_debit(tiny, prompts,
+                                                  replica_pair):
+    """Fast sibling of the flood test below: the apportioned per-tenant
+    pools sum to exactly the tier cap, a tagged request debits its
+    tenant's pool for its lifetime, and the credit returns on
+    completion."""
+    _, _, addrs = replica_pair
+    router = _router(addrs, credits=3,
+                     tenant_weights={"a": 3.0, "b": 1.0})
+    try:
+        # cap = 3 credits x 2 replicas = 6 over weights a:3 b:1
+        # default:1 -> quotas 3.6/1.2/1.2, largest remainder hands the
+        # leftover credit to a
+        shares = {t: q.credits for t, q in router._tenant_pools.items()}
+        assert sum(shares.values()) == 6
+        assert shares == {"a": 4, "b": 1, "default": 1}
+        toks = list(router.stream(prompts[0], 2, tenant="b"))
+        assert len(toks) == 2
+        st = router.stats()
+        assert st["tenant_credits"] == shares  # returned after the leg
+    finally:
+        router.close()
+
+
+@pytest.mark.slow
 def test_router_tenant_fair_share_two_tenants(tiny, prompts):
     """Per-tenant fair-share credits: two equal-weight tenants at
     ~10:1 offered load complete requests within 2x of their configured
     1:1 weights while both are active — the flooding tenant is bounded
     by its in-flight share, not by how many threads it throws at the
-    router (ScheduledQueue credit machinery, router.tenant_credits)."""
+    router (ScheduledQueue credit machinery, router.tenant_credits).
+    Slow: a 10-thread offered-load soak whose ratio assert needs an
+    unloaded host."""
     _, model, variables = tiny
     engine = ServingEngine(model, variables, n_slots=4, max_seq=64,
                            temperature=0.0, metrics=ServeMetrics())

@@ -152,11 +152,15 @@ def test_spec_seeded_parity(tiny, prompts):
         np.testing.assert_array_equal(r.result(), b)
 
 
+@pytest.mark.slow
 def test_spec_paged_parity_with_preempt_mid_speculation(tiny):
     """Paged + speculation + block pressure: a request preempted while
     speculation is active resumes by re-prefill and continues the
     parked token/key chain — both streams bit-identical to generate(),
-    greedy and seeded (the ISSUE's preempt-mid-speculation anchor)."""
+    greedy and seeded (the ISSUE's preempt-mid-speculation anchor).
+    Slow: paged-spec compile x preempt/resume (tier-1 duration
+    budget); test_spec_greedy_parity_and_compile_counts /
+    test_spec_seeded_parity keep the fast spec parity coverage."""
     _, model, variables = tiny
     pA = np.asarray((list(range(6)) * 4)[:19], np.int32)
     pB = np.asarray((list(range(7, 12)) * 4)[:18], np.int32)
