@@ -1069,6 +1069,196 @@ def router_affinity(groups: int = 3, per_group: int = 8,
     return row
 
 
+def autoscale_spike(tokens: int = 16, prompt_len: int = 12,
+                    slots: int = 4, d_model: int = 32, layers: int = 2,
+                    vocab: int = 61, max_replicas: int = 3,
+                    out_path: str = "BENCH_SERVE.json",
+                    archive: bool = True):
+    """Elastic-capacity A/B (docs/serving.md "Elastic capacity & SLO
+    classes"): the same 1x -> 4x -> 1x workload run twice — once with
+    the autoscaling controller live (the tier may grow from 1 to
+    ``max_replicas`` pre-started in-thread replicas behind an injected
+    launcher seam) and once FIXED at one replica.  The spike is a
+    fixed-duration closed loop (8 workers cycling guaranteed +
+    best-effort pairs), so the fixed tier saturates at any engine
+    speed and the elastic tier has several control intervals to
+    react.  Reported per leg:
+    ``guaranteed`` request latency p50 before/after the spike and
+    p50+p99 during it, shed counts per SLO class, and the controller's
+    scale events.  The claim: under the same sustained spike the
+    elastic tier sheds strictly fewer best-effort requests than the
+    fixed tier (added replicas turn would-be sheds into completions)
+    with a guaranteed spike tail no worse than fixed, sheds no
+    guaranteed work, and returns to the baseline replica count
+    afterwards.  (The shed count is the robust axis: both legs' p99
+    is dominated by the placement retry-backoff ladder once
+    saturated, so a strict p99 ordering is noise.)"""
+    from byteps_tpu.observability.metrics import MetricsRegistry
+    from byteps_tpu.resilience.policy import RetryPolicy
+    from byteps_tpu.serving import (OverloadShedError,
+                                    RemoteServeClient, ServeRouter)
+    from byteps_tpu.serving import router as rt
+    from byteps_tpu.serving.autoscale import (AutoscaleController,
+                                              ReplicaHandle,
+                                              ReplicaLauncher,
+                                              ScalePolicy, TierSignals,
+                                              poll_router)
+    from byteps_tpu.serving.frontend import serve
+
+    cfg = TransformerConfig(vocab_size=vocab, num_layers=layers,
+                            num_heads=2, d_model=d_model,
+                            d_ff=2 * d_model, max_seq_len=96,
+                            dtype=jnp.float32)
+    model = Transformer(cfg)
+    variables = model.init(jax.random.PRNGKey(1),
+                           jnp.zeros((1, 8), jnp.int32))
+    steady_ps = _prompts(4, prompt_len, vocab)
+    spike_g_ps = _prompts(8, prompt_len, vocab)
+    spike_b_ps = _prompts(8, prompt_len, vocab)
+
+    def run_leg(elastic: bool):
+        n_engines = max_replicas if elastic else 1
+        engines = [ServingEngine(model, variables, n_slots=slots,
+                                 max_seq=96, temperature=0.0,
+                                 metrics=ServeMetrics())
+                   for _ in range(n_engines)]
+        srvs = [serve(e, 0, host="127.0.0.1", in_thread=True)[0]
+                for e in engines]
+        addrs = ["127.0.0.1:%d" % s.server_address[1] for s in srvs]
+        for a in addrs:  # compile off-timer on every scale-up target
+            w = RemoteServeClient(a, timeout=30.0)
+            list(w.stream(steady_ps[0], 2))
+            w.close()
+        router = ServeRouter(
+            [addrs[0]], affinity=False, credits=2, deadline=60.0,
+            stream_timeout=10.0, registry=MetricsRegistry(),
+            retry=RetryPolicy(max_attempts=8, backoff_base=0.05,
+                              jitter=0.1, deadline=0.0),
+            slo_deadlines={"best-effort": 0.25},
+            service_estimate_s=0.5).start()
+        controller = None
+        if elastic:
+            pool = list(addrs[1:])
+            launcher = ReplicaLauncher(
+                spawn_fn=lambda: ReplicaHandle(pool.pop(0)),
+                stop_fn=lambda h: None)
+            controller = AutoscaleController(
+                router,
+                ScalePolicy(min_replicas=1, max_replicas=max_replicas,
+                            up_threshold=0.8, down_threshold=0.3,
+                            up_cooldown_s=0.5, down_cooldown_s=2.0),
+                TierSignals(poll_router(router), window_s=0.6),
+                launcher, interval_s=0.2).start()
+        lat = {"before": [], "spike": [], "after": []}
+        untyped = [0]
+        lock = threading.Lock()
+        peak = {"v": router.placeable_count()}
+        stop = threading.Event()
+
+        def sample():
+            while not stop.is_set():
+                peak["v"] = max(peak["v"], router.placeable_count())
+                time.sleep(0.02)
+
+        sampler = threading.Thread(target=sample, daemon=True)
+        sampler.start()
+
+        def one(phase, prompt, slo):
+            t0 = time.perf_counter()
+            try:
+                n = sum(1 for _ in router.stream(prompt, tokens,
+                                                 slo=slo))
+                dt = time.perf_counter() - t0
+                with lock:
+                    if slo == "guaranteed" and n == tokens:
+                        lat[phase].append(dt)
+            except OverloadShedError:
+                pass  # counted by the router's per-class shed metric
+            except Exception:
+                with lock:
+                    untyped[0] += 1
+
+        try:
+            for p in steady_ps:
+                one("before", p, "guaranteed")
+            # the spike: a fixed-duration closed loop, one worker per
+            # prompt pair, each cycling one guaranteed and one
+            # best-effort request until the window ends.  A one-shot
+            # burst is speed-fragile — a hot tier drains it inside one
+            # signal window and NEITHER leg ever queues, so the p99
+            # comparison measures noise; the closed loop saturates the
+            # fixed tier at any engine speed and spans several control
+            # intervals, which is what the elastic leg needs to react.
+            spike_end = time.monotonic() + 2.5
+
+            def spike_worker(pg, pb):
+                while time.monotonic() < spike_end:
+                    one("spike", pg, "guaranteed")
+                    one("spike", pb, "best-effort")
+
+            threads = [threading.Thread(
+                target=spike_worker, args=(pg, pb), daemon=True)
+                for pg, pb in zip(spike_g_ps, spike_b_ps)]
+            for t in threads:
+                t.start()
+                time.sleep(0.005)
+            for t in threads:
+                t.join(120.0)
+            if controller is not None:
+                # let the tier settle back to baseline before "after"
+                tdl = time.monotonic() + 30.0
+                while router.placeable_count() > 1 \
+                        and time.monotonic() < tdl:
+                    time.sleep(0.1)
+            for p in steady_ps:
+                one("after", p, "guaranteed")
+            stop.set()
+            sampler.join(5.0)
+            st = router.stats()
+            return {
+                "before_p50_s": _pctl(lat["before"], 50),
+                "spike_p50_s": _pctl(lat["spike"], 50),
+                "spike_p99_s": _pctl(lat["spike"], 99),
+                "after_p50_s": _pctl(lat["after"], 50),
+                "shed_guaranteed": st[rt.SHED_GUARANTEED],
+                "shed_standard": st[rt.SHED_STANDARD],
+                "shed_best_effort": st[rt.SHED_BEST_EFFORT],
+                "untyped": untyped[0],
+                "scale_ups": (controller.scale_ups
+                              if controller else 0),
+                "scale_downs": (controller.scale_downs
+                                if controller else 0),
+                "peak_replicas": peak["v"],
+                "final_replicas": router.placeable_count(),
+            }
+        finally:
+            stop.set()
+            if controller is not None:
+                controller.close()
+            router.close()
+            for s in srvs:
+                try:
+                    s.shutdown()
+                    s.server_close()
+                except Exception:
+                    pass
+
+    elastic = run_leg(True)
+    fixed = run_leg(False)
+    row = {"metric": "serve_autoscale_spike",
+           "backend": jax.default_backend(),
+           "tokens_per_request": tokens, "prompt_len": prompt_len,
+           "slots": slots, "d_model": d_model, "layers": layers,
+           "max_replicas": max_replicas,
+           "spike_guaranteed": len(spike_g_ps),
+           "spike_best_effort": len(spike_b_ps),
+           "autoscale": elastic, "fixed": fixed}
+    print(json.dumps(row), flush=True)
+    if archive:
+        _archive_rows([row], out_path)
+    return row
+
+
 def disagg_ab(shorts: int = 4, longs: int = 2, tokens: int = 16,
               short_len: int = 8, long_lens=(16, 64), slots: int = 6,
               d_model: int = 32, layers: int = 2, vocab: int = 61,
@@ -1257,6 +1447,11 @@ def main(argv=None) -> int:
                          "mixed long/short A/B (short-request decode "
                          "TPOT p99 vs long-prompt length, shipped-"
                          "block counters, parity asserted)")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="run only the elastic-capacity A/B (1x -> 4x "
+                         "-> 1x spike, autoscaled 1..3 replicas vs "
+                         "fixed 1; guaranteed latency before/during/"
+                         "after, shed counts per SLO class)")
     ap.add_argument("--spec", action="store_true",
                     help="run only the speculative-decoding A/B "
                          "(repetitive leg: accepted-tokens/tick + TPOT "
@@ -1264,6 +1459,28 @@ def main(argv=None) -> int:
                          "spec-on vs spec-off interleaved reps, parity "
                          "asserted)")
     args = ap.parse_args(argv)
+    if args.autoscale:
+        row = autoscale_spike(out_path=args.out,
+                              archive=not args.no_archive)
+        el, fx = row["autoscale"], row["fixed"]
+        ok = (el["untyped"] == 0 and fx["untyped"] == 0
+              and el["scale_ups"] >= 1 and el["scale_downs"] >= 1
+              and el["shed_guaranteed"] == 0
+              and el["peak_replicas"] > 1
+              and el["final_replicas"] == 1
+              and el["shed_best_effort"] < fx["shed_best_effort"]
+              and el["spike_p99_s"] <= fx["spike_p99_s"] * 1.1)
+        print(f"autoscale spike: guaranteed p99 during spike "
+              f"{el['spike_p99_s']}s elastic (peak "
+              f"{el['peak_replicas']} replicas) vs {fx['spike_p99_s']}s"
+              f" fixed, sheds g/s/b {el['shed_guaranteed']}/"
+              f"{el['shed_standard']}/{el['shed_best_effort']} elastic"
+              f" vs {fx['shed_guaranteed']}/{fx['shed_standard']}/"
+              f"{fx['shed_best_effort']} fixed "
+              f"({'PASS' if ok else 'FAIL'} scaled up+down, no "
+              f"guaranteed sheds, fewer best-effort sheds than fixed, "
+              f"guaranteed tail no worse)")
+        return 0 if ok else 1
     if args.disagg:
         row = disagg_ab(out_path=args.out,
                         archive=not args.no_archive)

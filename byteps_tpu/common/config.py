@@ -246,6 +246,37 @@ class Config:
     # max finished-but-unshipped parked KV entries a prefill engine
     # holds (refcounted blocks; oldest evicted + released beyond this)
     disagg_parked_cap: int = 32
+    # --- elastic capacity (serving/autoscale; docs/serving.md "Elastic
+    # capacity & SLO classes") -------------------------------------------
+    # run the autoscaling controller alongside the router role
+    autoscale: bool = False
+    # replica-count clamps for the scale policy
+    autoscale_min: int = 1
+    autoscale_max: int = 4
+    # hysteresis band: scale up above, down below (normalized load —
+    # 1.0 = the placeable tier exactly saturated)
+    autoscale_up: float = 0.8
+    autoscale_down: float = 0.3
+    # per-direction cooldowns (a fresh scale-up also pins scale-down)
+    autoscale_up_cooldown_ms: float = 5_000.0
+    autoscale_down_cooldown_ms: float = 15_000.0
+    # control-loop tick and the signal window it aggregates over
+    autoscale_interval_ms: float = 1_000.0
+    autoscale_window_ms: float = 5_000.0
+    # log decisions without acting (rehearsal mode)
+    autoscale_dry_run: bool = False
+    # SLO class assumed when a request carries no slo= wire param
+    slo_default: str = "standard"
+    # max tolerable estimated queue wait per class before the door
+    # sheds typed (guaranteed never sheds — infinite deadline)
+    slo_standard_deadline_ms: float = 10_000.0
+    slo_best_effort_deadline_ms: float = 1_000.0
+    # seed for the EWMA of observed service times the wait estimate
+    # runs on (replaced by measurements after the first completion)
+    slo_service_estimate_ms: float = 500.0
+    # work-conserving tenant shares: lend idle tenant credits (clawed
+    # back on demand); off = PR 14 strict reservation exactly
+    slo_borrow: bool = True
 
     # --- pipelined wire engine (byteps_tpu/engine/wire.py; the client
     # half of the push/pull pipelining BytePS keeps the wire busy with —
@@ -394,6 +425,28 @@ class Config:
                 "BYTEPS_DISAGG_SHIP_TIMEOUT_MS", 10_000.0),
             disagg_ship_retries=_env_int("BYTEPS_DISAGG_SHIP_RETRIES", 2),
             disagg_parked_cap=_env_int("BYTEPS_DISAGG_PARKED_CAP", 32),
+            autoscale=_env_bool("BYTEPS_AUTOSCALE"),
+            autoscale_min=_env_int("BYTEPS_AUTOSCALE_MIN", 1),
+            autoscale_max=_env_int("BYTEPS_AUTOSCALE_MAX", 4),
+            autoscale_up=_env_float("BYTEPS_AUTOSCALE_UP", 0.8),
+            autoscale_down=_env_float("BYTEPS_AUTOSCALE_DOWN", 0.3),
+            autoscale_up_cooldown_ms=_env_float(
+                "BYTEPS_AUTOSCALE_UP_COOLDOWN_MS", 5_000.0),
+            autoscale_down_cooldown_ms=_env_float(
+                "BYTEPS_AUTOSCALE_DOWN_COOLDOWN_MS", 15_000.0),
+            autoscale_interval_ms=_env_float(
+                "BYTEPS_AUTOSCALE_INTERVAL_MS", 1_000.0),
+            autoscale_window_ms=_env_float(
+                "BYTEPS_AUTOSCALE_WINDOW_MS", 5_000.0),
+            autoscale_dry_run=_env_bool("BYTEPS_AUTOSCALE_DRY_RUN"),
+            slo_default=_env_str("BYTEPS_SLO_DEFAULT", "standard"),
+            slo_standard_deadline_ms=_env_float(
+                "BYTEPS_SLO_STANDARD_DEADLINE_MS", 10_000.0),
+            slo_best_effort_deadline_ms=_env_float(
+                "BYTEPS_SLO_BEST_EFFORT_DEADLINE_MS", 1_000.0),
+            slo_service_estimate_ms=_env_float(
+                "BYTEPS_SLO_SERVICE_ESTIMATE_MS", 500.0),
+            slo_borrow=_env_bool("BYTEPS_SLO_BORROW", True),
             wire_window=_env_int("BYTEPS_WIRE_WINDOW", 8),
             wire_fanout=_env_int("BYTEPS_WIRE_FANOUT", 16),
             transport=_env_str("BYTEPS_TRANSPORT", "auto"),

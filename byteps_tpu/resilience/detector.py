@@ -78,6 +78,19 @@ class FailureDetector:
         with self._lock:
             return sorted(self._down)
 
+    def grow(self, n: int = 1) -> int:
+        """Extend the monitored range by ``n`` shards (elastic serving
+        tiers add replicas at runtime).  New shards start with a clean
+        miss count; the heartbeat loop picks them up on its next pass.
+        Returns the new shard count."""
+        if n < 1:
+            raise ValueError("n must be >= 1")
+        with self._lock:
+            for i in range(self.num_shards, self.num_shards + n):
+                self._misses[i] = 0
+            self.num_shards += n
+            return self.num_shards
+
     # ------------------------------------------------------------ transitions
 
     def report_failure(self, shard: int) -> None:
@@ -130,7 +143,9 @@ class FailureDetector:
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval):
-            for shard in range(self.num_shards):
+            with self._lock:
+                count = self.num_shards  # grow() moves it at runtime
+            for shard in range(count):
                 if self._stop.is_set():
                     return
                 try:

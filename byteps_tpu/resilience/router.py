@@ -48,6 +48,17 @@ class DegradedModeRouter:
         with self._lock:
             return sorted(self._down)
 
+    def grow(self, n: int = 1) -> int:
+        """Extend the routable range by ``n`` shards (elastic serving
+        tiers add replicas at runtime).  Remap targets recompute from
+        the new count on the next ``route`` call.  Returns the new
+        shard count."""
+        if n < 1:
+            raise ValueError("n must be >= 1")
+        with self._lock:
+            self.num_shards += n
+            return self.num_shards
+
     def mark_down(self, shard: int) -> bool:
         """Exclude ``shard`` from routing; True if this call changed
         state (callers bump the failover counter only on the edge)."""

@@ -53,6 +53,13 @@ kernels into a *serving engine*:
     KV as paged blocks over ``OP_KV_BLOCKS`` to the decode replica the
     router chose, which adopts them through the resume machinery —
     bit-exact, with decode-side re-prefill as the availability floor;
+  * ``autoscale`` — the elastic-capacity subsystem (docs/serving.md
+    "Elastic capacity & SLO classes"): windowed tier signals, a
+    hysteresis-banded target-tracking scale policy, a launcher-backed
+    actuator that journals scale events for HA takeover, and SLO-class
+    admission — deadline-aware shedding (typed ``OverloadShedError``)
+    plus work-conserving tenant shares (idle credits are lent and
+    clawed back on demand);
   * ``metrics`` — TTFT/TPOT/queue-wait and occupancy/tokens-per-sec
     counters exported through the process ``Tracer``.
 
@@ -61,6 +68,16 @@ output is token-identical to sequential ``generate()`` per request —
 see docs/serving.md.
 """
 
+from .autoscale import (  # noqa: F401
+    AutoscaleController,
+    OverloadShedError,
+    ReplicaLauncher,
+    ScaleDecision,
+    ScalePolicy,
+    TenantShares,
+    TierSignals,
+    normalize_slo,
+)
 from .blocks import (  # noqa: F401
     BlockAllocator,
     BlocksExhaustedError,

@@ -115,6 +115,14 @@ class ServeConnectionError(ConnectionError):
 # surface to the caller, never be retried as if the router were dead.
 _RETRYABLE_REPLY_NAMES = frozenset({"RouterStandbyError"})
 
+# status=1 names that mean "shed under overload" (the router's
+# SLO-class admission door — docs/serving.md "Elastic capacity & SLO
+# classes"): NOT router-rotation-retryable (every router fronts the
+# same saturated tier; rotating would just burn the deadline), but
+# safe for the CALLER to retry with backoff — the request was never
+# placed.  ``ServeReplyError.shed`` flags them.
+_SHED_REPLY_NAMES = frozenset({"OverloadShedError"})
+
 
 class ServeReplyError(RuntimeError):
     """A status=1 reply frame: the endpoint is alive and answered with
@@ -124,11 +132,14 @@ class ServeReplyError(RuntimeError):
     help (a standby refusal) or the refusal would recur anywhere
     (weights mismatch, infeasible request, tier failure) — retrying
     those as if the router were dead would burn the deadline repeating
-    a deterministic error."""
+    a deterministic error.  ``shed`` marks an SLO-class overload shed:
+    back off and resubmit later (``retryable`` stays False — a
+    DIFFERENT router cannot help, only time can)."""
 
     def __init__(self, msg: str, name: str = ""):
         self.name = name
         self.retryable = name in _RETRYABLE_REPLY_NAMES
+        self.shed = name in _SHED_REPLY_NAMES
         super().__init__(msg)
 
 
@@ -784,7 +795,8 @@ class RemoteServeClient:
             return self._read_frame()
 
     @staticmethod
-    def _extra(epoch, rid, tenant, extra=None) -> Optional[dict]:
+    def _extra(epoch, rid, tenant, extra=None,
+               slo=None) -> Optional[dict]:
         out = dict(extra) if extra else {}
         if epoch is not None:
             out["epoch"] = epoch
@@ -792,42 +804,47 @@ class RemoteServeClient:
             out["rid"] = rid
         if tenant is not None:
             out["tenant"] = tenant
+        if slo is not None:
+            out["slo"] = slo
         return out or None
 
     def generate(self, prompt, max_new_tokens: int, *, seed: int = 0,
                  priority: int = 0, resume=None, epoch=None, rid=None,
-                 tenant=None, extra=None) -> np.ndarray:
+                 tenant=None, slo=None, extra=None) -> np.ndarray:
         """Blocking submit -> the full token array.  Raises the typed
         :class:`ServeConnectionError` when the frontend dies first
         (after the deadline-bounded failover loop, on a multi-router
-        client).  ``extra`` = additional wire params merged into the
-        submit frame (the router's disagg ``kv_ship`` hand-off rides
-        here — docs/serving.md "Disaggregated tiers")."""
+        client).  ``slo`` = the request's SLO class wire param
+        (``guaranteed``/``standard``/``best-effort`` — a router may
+        shed it typed, ``ServeReplyError.shed``).  ``extra`` =
+        additional wire params merged into the submit frame (the
+        router's disagg ``kv_ship`` hand-off rides here —
+        docs/serving.md "Disaggregated tiers")."""
         if len(self._addrs) == 1:
             return self._generate_once(prompt, max_new_tokens,
                                        seed=seed, priority=priority,
                                        resume=resume, epoch=epoch,
                                        rid=rid, tenant=tenant,
-                                       extra=extra)
+                                       slo=slo, extra=extra)
         deadline = time.monotonic() + self.timeout
         while True:
             try:
                 return self._generate_once(
                     prompt, max_new_tokens, seed=seed,
                     priority=priority, resume=resume, epoch=epoch,
-                    rid=rid, tenant=tenant, extra=extra)
+                    rid=rid, tenant=tenant, slo=slo, extra=extra)
             except (ServeConnectionError, ServeReplyError) as e:
                 self._note_failover(e, deadline)
 
     def _generate_once(self, prompt, max_new_tokens: int, *, seed, priority,
-                       resume, epoch, rid, tenant,
+                       resume, epoch, rid, tenant, slo=None,
                        extra=None) -> np.ndarray:
         with self._lock:
             self._check_usable()
             self._send(_submit_frame(OP_SUBMIT, prompt, max_new_tokens,
                                      seed, priority, resume,
                                      self._extra(epoch, rid, tenant,
-                                                 extra)))
+                                                 extra, slo)))
             _, out, _ = self._read_frame()
         return np.array(out)
 
@@ -876,7 +893,7 @@ class RemoteServeClient:
 
     def stream(self, prompt, max_new_tokens: int, *, seed: int = 0,
                priority: int = 0, resume=None, epoch=None, rid=None,
-               tenant=None, extra=None):
+               tenant=None, slo=None, extra=None):
         """Token iterator over the OP_STREAM wire op: yields each token
         as its frame arrives (``resume`` = already-emitted tokens for a
         failover re-dispatch — only NEW tokens are streamed back).  A
@@ -898,15 +915,17 @@ class RemoteServeClient:
             return self._stream_once(prompt, max_new_tokens, seed=seed,
                                      priority=priority, resume=resume,
                                      epoch=epoch, rid=rid,
-                                     tenant=tenant, extra=extra)
+                                     tenant=tenant, slo=slo,
+                                     extra=extra)
         return self._stream_failover(prompt, max_new_tokens, seed=seed,
                                      priority=priority, resume=resume,
                                      epoch=epoch, rid=rid,
-                                     tenant=tenant, extra=extra)
+                                     tenant=tenant, slo=slo,
+                                     extra=extra)
 
     def _stream_failover(self, prompt, max_new_tokens: int, *, seed,
                          priority, resume, epoch, rid, tenant,
-                         extra=None):
+                         slo=None, extra=None):
         emitted: List[int] = ([int(t) for t in resume]
                               if resume is not None else [])
         deadline = time.monotonic() + self.timeout
@@ -916,7 +935,7 @@ class RemoteServeClient:
                         prompt, max_new_tokens, seed=seed,
                         priority=priority, resume=emitted or None,
                         epoch=epoch, rid=rid, tenant=tenant,
-                        extra=extra):
+                        slo=slo, extra=extra):
                     emitted.append(int(tok))
                     # the failover budget is timeout WITHOUT PROGRESS:
                     # a healthy stream longer than self.timeout must
@@ -935,7 +954,8 @@ class RemoteServeClient:
                 self._note_failover(e, deadline)
 
     def _stream_once(self, prompt, max_new_tokens: int, *, seed,
-                     priority, resume, epoch, rid, tenant, extra=None):
+                     priority, resume, epoch, rid, tenant, slo=None,
+                     extra=None):
         with self._lock:
             self._check_usable()
             in_flight = False
@@ -948,7 +968,8 @@ class RemoteServeClient:
                                          max_new_tokens, seed,
                                          priority, resume,
                                          self._extra(epoch, rid,
-                                                     tenant, extra)))
+                                                     tenant, extra,
+                                                     slo)))
                 in_flight = True
                 while True:
                     try:

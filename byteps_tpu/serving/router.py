@@ -116,6 +116,10 @@ from ..observability.metrics import MetricsRegistry, get_registry
 from ..resilience.detector import FailureDetector
 from ..resilience.policy import RetryPolicy
 from ..resilience.router import DegradedModeRouter
+from .autoscale.admission import (SLO_BEST_EFFORT, SLO_GUARANTEED,
+                                  SLO_STANDARD, AdmissionController,
+                                  Lease, OverloadShedError, TenantShares,
+                                  normalize_slo)
 from .frontend import (OP_CANCEL, OP_JOURNAL, OP_PING, OP_STATS,
                        OP_STREAM, OP_SUBMIT, RemoteServeClient,
                        ServeConnectionError, ServeReplyError,
@@ -171,6 +175,24 @@ TENANT_CREDITS = "router.tenant_credits"
 DISAGG_PREFILLS = "router.disagg_prefills"
 DISAGG_SHIPPED_BLOCKS = "router.disagg_shipped_blocks"
 DISAGG_FALLBACKS = "router.disagg_fallbacks"
+# --- elastic capacity (docs/serving.md "Elastic capacity & SLO
+# classes"): per-class door sheds (incl. clawed-back borrows), total
+# credits borrowed across tenant pools, and journaled QUEUED requests
+# the NEW active re-dispatched itself at takeover
+SHED_GUARANTEED = "router.shed_guaranteed"
+SHED_STANDARD = "router.shed_standard"
+SHED_BEST_EFFORT = "router.shed_best_effort"
+BORROWED_CREDITS = "router.borrowed_credits"
+QUEUED_REDISPATCHES = "router.queued_redispatches"
+
+_SHED_COUNTER = {SLO_GUARANTEED: SHED_GUARANTEED,
+                 SLO_STANDARD: SHED_STANDARD,
+                 SLO_BEST_EFFORT: SHED_BEST_EFFORT}
+
+# journaled in-flight record fields ("p" — a still-QUEUED record's
+# prompt — rides separately, only while r is None)
+_JOURNAL_FIELDS = ("rid", "seed", "prio", "mnt", "tenant", "slo",
+                   "r", "n", "st")
 
 
 class ReplicaState(enum.Enum):
@@ -286,7 +308,11 @@ class ServeRouter:
                  tenant_weights: Optional[Dict[str, float]] = None,
                  journal_every: int = 8,
                  roles: Optional[Sequence[str]] = None,
-                 disagg: bool = True):
+                 disagg: bool = True,
+                 slo_default: str = SLO_STANDARD,
+                 slo_deadlines: Optional[Dict[str, float]] = None,
+                 service_estimate_s: float = 0.5,
+                 slo_borrow: bool = True):
         if not replicas:
             raise ValueError(
                 "ServeRouter needs at least one replica address "
@@ -462,6 +488,28 @@ class ServeRouter:
                     name=f"router.tenant.{t}")
                 self._gauge_tenant(t)
 
+        # ---- SLO admission + work-conserving shares ------------------
+        # (docs/serving.md "Elastic capacity & SLO classes"): classes
+        # shed at the door when the estimated queue wait blows their
+        # deadline, and the strict tenant pools above become a FLOOR —
+        # idle credits are lent across tenants and clawed back on
+        # demand (TenantShares).
+        self.slo_default = normalize_slo(slo_default)
+        self._admission = AdmissionController(
+            deadlines=slo_deadlines,
+            service_estimate_s=service_estimate_s)
+        self._shares = TenantShares(self._tenant_pools,
+                                    borrow=slo_borrow,
+                                    on_borrow=self._on_borrow)
+        # takeover re-dispatch: rid -> parked token buffer the client's
+        # retry attaches to (bounded — see _park_redispatch)
+        self._parked: Dict[str, dict] = {}
+        self._parked_cv = threading.Condition()
+        # the scale intent (k="scale" journal entry) currently open —
+        # kept on the active AND folded on standbys, so a takeover
+        # mid-scale reconciles it instead of orphaning the spawn
+        self._pending_scale: Optional[dict] = None
+
     # ------------------------------------------------------------ lifecycle
 
     def start(self) -> "ServeRouter":
@@ -535,7 +583,11 @@ class ServeRouter:
         self._jpub(**self._replica_entry(r))
 
     def _replica_entry(self, r: _Replica) -> dict:
-        return {"k": "replica", "r": r.idx, "dead": r.dead,
+        # the address rides along so standbys can APPEND replicas the
+        # active scaled up at runtime (add_replica), not just fold
+        # verdicts for a roster they already share
+        return {"k": "replica", "r": r.idx, "addr": r.addr,
+                "role": r.role, "dead": r.dead,
                 "refused": r.refused, "verified": r.verified,
                 "draining": r.draining or r.retired,
                 "fp": self._expected_fp}
@@ -553,12 +605,16 @@ class ServeRouter:
             ents.extend(self._replica_entry(r) for r in self._replicas)
             ents.extend({"k": "affinity", "d": d.hex(), "r": i}
                         for d, i in self._affinity_map.items())
-            ents.extend(
-                {"k": "inflight",
-                 **{f: rec[f] for f in ("rid", "seed", "prio", "mnt",
-                                        "tenant", "r", "n", "st")}}
-                for rec in self._inflight.values()
-                if rec.get("r") is not None)
+            for rec in self._inflight.values():
+                ent = {"k": "inflight",
+                       **{f: rec[f] for f in _JOURNAL_FIELDS}}
+                if rec.get("r") is None and "p" in rec:
+                    # QUEUED records carry their prompt: a takeover
+                    # re-dispatches them instead of orphaning them
+                    ent["p"] = rec["p"]
+                ents.append(ent)
+            if self._pending_scale is not None:
+                ents.append(dict(self._pending_scale))
             for ent in ents:
                 ent["e"] = self.epoch
                 ent["src"] = self._self_idx
@@ -600,14 +656,33 @@ class ServeRouter:
                     while len(self._affinity_map) > self._affinity_cap:
                         self._affinity_map.popitem(last=False)
                 elif k == "inflight":
-                    self._journal_inflight[str(ent["rid"])] = ent
-                    self._journal_inflight.move_to_end(str(ent["rid"]))
+                    rid = str(ent["rid"])
+                    old = self._journal_inflight.get(rid)
+                    # MERGE, don't replace: the queued record's prompt
+                    # ("p") arrives once at admission — a later count
+                    # update must not erase it
+                    self._journal_inflight[rid] = (
+                        {**old, **ent} if old else ent)
+                    self._journal_inflight.move_to_end(rid)
                     while len(self._journal_inflight) > 4096:
                         self._journal_inflight.popitem(last=False)
                 elif k == "done":
                     self._journal_inflight.pop(str(ent["rid"]), None)
+                elif k == "scale":
+                    self._pending_scale = (
+                        None if ent.get("phase") in ("done", "abort")
+                        else dict(ent))
                 elif k == "replica":
                     i = int(ent["r"])
+                    if i == len(self._replicas) and ent.get("addr"):
+                        # a replica the active scaled UP at runtime
+                        # (add_replica journals the address): append it
+                        # so a takeover owns the grown tier
+                        self._replicas.append(_Replica(
+                            i, str(ent["addr"]),
+                            str(ent.get("role") or "both")))
+                        self._detector.grow(1)
+                        self._degraded.grow(1)
                     if 0 <= i < len(self._replicas):
                         r = self._replicas[i]
                         r.dead = bool(ent.get("dead"))
@@ -720,7 +795,21 @@ class ServeRouter:
             self._journal_epoch = self.epoch
             self._active = True
             self._active_peer = self._self_idx
-            orphans = len(self._journal_inflight)
+            # journaled in-flight records split two ways: QUEUED ones
+            # (never placed, emitted nothing, prompt journaled) are
+            # re-dispatched by US — the client's retry attaches to the
+            # parked stream by rid; records that already reached a
+            # replica stay orphans (their clients hold the tokens and
+            # re-issue with resume — the honest recovery window)
+            requeue: List[dict] = []
+            orphans = 0
+            for ent in self._journal_inflight.values():
+                if (ent.get("r") is None and not ent.get("n")
+                        and ent.get("p")
+                        and len(requeue) < self._parked_cap):
+                    requeue.append(dict(ent))
+                else:
+                    orphans += 1
             self._journal_inflight.clear()
         self._bump(TAKEOVERS)
         if orphans:
@@ -733,12 +822,15 @@ class ServeRouter:
         self._jpub(k="hello")
         for r in self._replicas:
             self._jpub_replica(r)
+        for ent in requeue:
+            self._park_redispatch(ent)
         bps_log.warning(
             "router %s: TAKEOVER — assuming epoch %d with %d journaled "
-            "affinity group(s), %d orphaned in-flight record(s) "
-            "(clients recover them via resume_tokens)",
+            "affinity group(s), %d queued request(s) re-dispatched, "
+            "%d orphaned in-flight record(s) (clients recover them "
+            "via resume_tokens)",
             self.self_addr or self._self_idx, self.epoch,
-            len(self._affinity_map), orphans)
+            len(self._affinity_map), len(requeue), orphans)
 
     def _demote(self, higher_epoch: int) -> None:
         """A higher epoch exists (journal ack, incoming journal, or a
@@ -830,6 +922,10 @@ class ServeRouter:
         self._registry.gauge(TENANT_CREDITS, track="router",
                              tenant=tenant).set(
             self._tenant_pools[tenant].credits)
+
+    def _on_borrow(self, tenant: str, lender: str) -> None:
+        self._bump(BORROWED_CREDITS)
+        self._gauge_tenant(lender)
 
     # -------------------------------------------------------------- metrics
 
@@ -1142,7 +1238,8 @@ class ServeRouter:
     def stream(self, prompt, max_new_tokens: int, *, seed: int = 0,
                priority: int = 0, deadline: Optional[float] = None,
                resume=None, rid: Optional[str] = None,
-               tenant: Optional[str] = None):
+               tenant: Optional[str] = None,
+               slo: Optional[str] = None, _redispatch: bool = False):
         """Token iterator: place the request, stream its tokens, and on
         replica death re-dispatch to a survivor with the emitted prefix
         — the consumer sees one uninterrupted, token-identical
@@ -1157,7 +1254,19 @@ class ServeRouter:
         ``rid`` (caller-chosen, minted when absent) names the request
         for OP_CANCEL propagation and the HA journal's in-flight
         record; ``tenant`` debits that tenant's fair-share credit pool
-        when tenant weights are configured."""
+        when tenant weights are configured.
+
+        ``slo`` names the request's class (``guaranteed`` /
+        ``standard`` / ``best-effort`` — docs/serving.md "Elastic
+        capacity & SLO classes"): when the estimated queue wait blows
+        the class deadline the request sheds AT THE DOOR with the
+        typed, retryable :class:`OverloadShedError` instead of
+        queueing into a miss.  A best-effort stream running on a
+        BORROWED tenant credit additionally sheds mid-flight when the
+        lender claws its credit back.  ``_redispatch`` is internal:
+        the takeover path re-running a journaled queued record (skips
+        admission — it was admitted once — and parks its tokens for
+        the client to attach to)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         emitted: List[int] = ([int(t) for t in resume]
                               if resume is not None else [])
@@ -1172,22 +1281,56 @@ class ServeRouter:
                 f"router {self.self_addr or self._self_idx} is standby "
                 f"(epoch owner: peer {self._active_peer}); retry the "
                 f"active router")
-        self._bump(REQUESTS)
+        slo_class = normalize_slo(slo, self.slo_default)
         deadline_ts = time.monotonic() + (
             deadline if deadline is not None else self.deadline)
+        rid = str(rid) if rid else f"r{self._self_idx}.{next(self._rid_seq)}"
+        if not _redispatch:
+            with self._parked_cv:
+                parked = self._parked.get(rid)
+            if parked is not None:
+                # a takeover re-dispatch already runs this request:
+                # attach to its parked stream instead of re-submitting
+                yield from self._attach_parked(rid, parked, emitted,
+                                               deadline_ts)
+                return
+        self._bump(REQUESTS)
+        if not _redispatch:
+            # deadline-aware admission: estimate the queue wait from
+            # the live backlog and shed typed AT THE DOOR when the
+            # class deadline cannot be met (guaranteed never sheds by
+            # default — infinite deadline)
+            with self._lock:
+                queued = sum(1 for q in self._inflight.values()
+                             if q.get("r") is None)
+                busy = sum(r.inflight for r in self._replicas)
+                cap = self.credits * sum(
+                    1 for r in self._replicas
+                    if r.placeable and r.role != "prefill")
+            try:
+                self._admission.admit(slo_class, busy, queued, cap)
+            except OverloadShedError:
+                self._bump(_SHED_COUNTER[slo_class])
+                raise
+        t_start = time.monotonic()
         digest = self._digest(prompt)
         dispatched = False  # a leg reached a replica at least once
         tried: Set[int] = set()
         attempt = 0  # consecutive no-progress attempts (resets on tokens)
         stalls = 0   # consecutive no-placeable-replica waits
-        rid = str(rid) if rid else f"r{self._self_idx}.{next(self._rid_seq)}"
         rec = {"rid": rid, "seed": int(seed), "prio": int(priority),
                "mnt": int(max_new_tokens), "tenant": tenant,
+               "slo": slo_class,
                "r": None, "n": len(emitted), "cancelled": False,
                # dispatch stage, journaled to standbys: None (normal)
                # or "ship" (PREFILL_SHIPPING — a takeover knows the
                # request was mid-prefill-leg and owns no decode slot)
                "st": None}
+        if (self._journal is not None and not emitted
+                and len(prompt) <= 4096):
+            # the QUEUED record's prompt, journaled so a takeover can
+            # re-dispatch a request that never reached a replica
+            rec["p"] = [int(t) for t in prompt]
         with self._lock:
             if rid in self._cancel_tombs:
                 del self._cancel_tombs[rid]
@@ -1216,36 +1359,54 @@ class ServeRouter:
             self._bump(RETRIES)
             self.retry.sleep(attempt + 1)
 
+        journaled = False
+
         def _jpub_inflight():
-            self._jpub(k="inflight",
-                       **{f: rec[f] for f in ("rid", "seed", "prio",
-                                              "mnt", "tenant", "r",
-                                              "n", "st")})
+            nonlocal journaled
+            ent = {f: rec[f] for f in _JOURNAL_FIELDS}
+            if rec["r"] is None and "p" in rec:
+                ent["p"] = rec["p"]
+            journaled = True
+            self._jpub(k="inflight", **ent)
 
         tname = (tenant if tenant in self._tenant_pools else "default")
-        pool = self._tenant_pools.get(tname)
-        debited = False
+        lease: Optional[Lease] = None
+
+        def _claw_check():
+            # the work-conserving contract's teeth: a borrowed credit
+            # flagged by clawback sheds this stream typed at its next
+            # pace point, and release() sends the credit home
+            if lease is not None and lease.reclaimed:
+                self._bump(_SHED_COUNTER[slo_class])
+                raise OverloadShedError(
+                    slo_class, 0.0, 0.0,
+                    reason="borrowed credit clawed back")
+
         try:
-            if pool is not None:
-                # fair-share gate: ONE credit of the tenant's pool for
-                # the request's whole lifetime (held across failover
-                # re-dispatches — the pool bounds in-flight share, not
-                # attempts).  Deadline-bounded like saturation.
-                while True:
+            if not _redispatch:
+                # journal the QUEUED record before any gate: a
+                # takeover between here and placement re-dispatches it
+                _jpub_inflight()
+            if self._tenant_pools:
+                # fair-share gate: ONE credit for the request's whole
+                # lifetime (held across failover re-dispatches — it
+                # bounds in-flight share, not attempts).  Own pool
+                # first, then a BORROWED idle credit (work-conserving
+                # shares), then block on the own pool clawing our
+                # outstanding loans back.  Deadline-bounded.
+                lease = self._shares.acquire(
+                    tname,
+                    reclaimable=(slo_class == SLO_BEST_EFFORT),
+                    timeout=max(0.0, deadline_ts - time.monotonic()),
+                    should_abort=lambda: bool(rec["cancelled"]))
+                if lease is None:
                     if rec["cancelled"]:
                         self._bump(CANCELLED)
                         return
-                    left = deadline_ts - time.monotonic()
-                    if left <= 0:
-                        _give_up(
-                            f"tenant {tname!r} at its fair-share "
-                            f"in-flight limit for the whole deadline "
-                            f"(router.tenant_credits)")
-                    # CV-woken wait (credit() notifies) in short
-                    # chunks so a cancel/deadline still lands promptly
-                    if pool.debit_wait(1, min(0.05, left)):
-                        break
-                debited = True
+                    _give_up(
+                        f"tenant {tname!r} at its fair-share "
+                        f"in-flight limit for the whole deadline "
+                        f"(router.tenant_credits)")
                 self._gauge_tenant(tname)
             # ---- disaggregated prefill leg (docs/serving.md) ---------
             # One-shot: run the prompt on a prefill-role replica with
@@ -1359,6 +1520,7 @@ class ServeRouter:
                 if rec["cancelled"]:
                     self._bump(CANCELLED)
                     return
+                _claw_check()
                 if not self._active:
                     # deposed mid-request (epoch fence / higher-epoch
                     # journal): the new epoch's router owns the tier —
@@ -1452,6 +1614,7 @@ class ServeRouter:
                             # cancels the slot
                             self._bump(CANCELLED)
                             return
+                        _claw_check()
                         emitted.append(int(tok))
                         attempt = 0
                         tried.clear()
@@ -1466,6 +1629,11 @@ class ServeRouter:
                     else:
                         self._bump(COMPLETED)
                     return
+                except OverloadShedError:
+                    # our own clawback shed (_claw_check inside the
+                    # token loop): typed, not a replica failure — the
+                    # leg teardown below still runs
+                    raise
                 except (ServeConnectionError, OSError) as e:
                     # the replica died or stalled mid-leg (connect
                     # refused, reset mid-stream, no token within
@@ -1544,23 +1712,35 @@ class ServeRouter:
                 self._rid_done[rid] = None
                 while len(self._rid_done) > 1024:
                     self._rid_done.popitem(last=False)
-            if debited:
-                pool.credit(1)
-                self._gauge_tenant(tname)
-            if dispatched:
+            if lease is not None:
+                # a borrowed credit flows back to the LENDER's pool —
+                # that release IS the clawback's delivery mechanism
+                self._shares.release(lease)
+                self._gauge_tenant(lease.lender or tname)
+            if dispatched and emitted:
+                # feed the EWMA service-time estimate the admission
+                # door's queue-wait math runs on
+                self._admission.note_service(
+                    max(0.0, time.monotonic() - t_start))
+            if journaled:
+                # "done" retires the journaled record whether or not a
+                # replica was ever reached — a standby must not
+                # re-dispatch a request that already failed typed here
                 self._jpub(k="done", rid=rid)
 
     def generate(self, prompt, max_new_tokens: int, *, seed: int = 0,
                  priority: int = 0, deadline: Optional[float] = None,
                  resume=None, rid: Optional[str] = None,
-                 tenant: Optional[str] = None) -> np.ndarray:
+                 tenant: Optional[str] = None,
+                 slo: Optional[str] = None) -> np.ndarray:
         """Blocking dispatch -> the NEW tokens (the OP_SUBMIT analog
         of :meth:`stream`; with ``resume`` the caller already holds
         the prefix, so only the continuation comes back)."""
         return np.asarray(
             list(self.stream(prompt, max_new_tokens, seed=seed,
                              priority=priority, deadline=deadline,
-                             resume=resume, rid=rid, tenant=tenant)),
+                             resume=resume, rid=rid, tenant=tenant,
+                             slo=slo)),
             np.int32)
 
     # ----------------------------------------------------------------- drain
@@ -1575,6 +1755,11 @@ class ServeRouter:
         deadline_ts = (time.monotonic() + timeout
                        if timeout is not None else None)
         with self._lock:
+            if r.retired:
+                # idempotent: a takeover reconcile and the autoscale
+                # controller may both retire the same replica — the
+                # second call must be a no-op, not a second drain
+                return
             r.draining = True
             self._gauge_state(r)
             for d in [d for d, i in self._affinity_map.items()
@@ -1593,6 +1778,162 @@ class ServeRouter:
         self._jpub_replica(r)
         bps_log.info("router: replica %d (%s) drained and retired",
                      idx, r.addr)
+
+    # ---------------------------------------------------- elastic capacity
+
+    def placeable_count(self) -> int:
+        """Replicas currently accepting normal placement — the
+        autoscale policy's notion of tier size (prefill-role replicas
+        are not decode capacity)."""
+        with self._lock:
+            return sum(1 for r in self._replicas
+                       if r.placeable and r.role != "prefill")
+
+    def signal_snapshot(self) -> Dict[str, int]:
+        """Load signals for the in-process autoscale sampler
+        (``autoscale.signals.poll_router``): in-flight legs vs the
+        placeable tier's credit capacity, plus the admission-queue
+        depth (admitted but not yet placed)."""
+        with self._lock:
+            cap = self.credits * sum(
+                1 for r in self._replicas
+                if r.placeable and r.role != "prefill")
+            busy = sum(r.inflight for r in self._replicas)
+            queued = sum(1 for rec in self._inflight.values()
+                         if rec.get("r") is None)
+        return {"inflight": busy, "capacity": cap, "queued": queued}
+
+    def replica_index(self, addr: str) -> Optional[int]:
+        """Roster index of the (non-retired) replica at ``addr``, or
+        None — how the takeover reconcile maps a journaled scale
+        intent back onto the roster."""
+        with self._lock:
+            for r in self._replicas:
+                if r.addr == addr and not r.retired:
+                    return r.idx
+        return None
+
+    def add_replica(self, addr: str, role: str = "both") -> int:
+        """Register a NEW replica with the running tier (the autoscale
+        actuator's scale-up path).  The replica joins the roster and
+        the heartbeat/degraded maps, then must pass the same weights-
+        fingerprint handshake registration runs — a wrong-checkpoint
+        spawn raises typed and never takes traffic.  The journaled
+        roster entry carries the address, so HA standbys append the
+        same replica (a takeover mid-scale-up owns the grown tier
+        instead of orphaning the spawn).  Idempotent on address."""
+        addr = str(addr).strip()
+        if role not in ("prefill", "decode", "both"):
+            raise ValueError(f"unknown replica role {role!r}")
+        with self._lock:
+            for r in self._replicas:
+                if r.addr == addr and not r.retired:
+                    return r.idx
+            r = _Replica(len(self._replicas), addr, role)
+            self._replicas.append(r)
+            self._detector.grow(1)
+            self._degraded.grow(1)
+        self._gauge_state(r)
+        self._verify_replica_weights(r, raising=True)
+        self._jpub_replica(r)
+        with self._lock:
+            self._cv.notify_all()
+        return r.idx
+
+    def journal_scale(self, op: str, addr: Optional[str] = None,
+                      idx: Optional[int] = None,
+                      phase: str = "intent") -> None:
+        """Journal one scale event (``k="scale"``).  Standbys fold the
+        open intent into :meth:`pending_scale`, so a takeover
+        mid-scale reconciles it (``AutoscaleController.
+        reconcile_takeover``) instead of orphaning a spawning replica
+        or double-draining a retiring one."""
+        ent = {"k": "scale", "op": str(op), "addr": addr, "i": idx,
+               "phase": str(phase)}
+        with self._lock:
+            self._pending_scale = (None if phase in ("done", "abort")
+                                   else dict(ent))
+        self._jpub(**ent)
+
+    def pending_scale(self) -> Optional[dict]:
+        with self._lock:
+            return (dict(self._pending_scale)
+                    if self._pending_scale else None)
+
+    # ------------------------------------- takeover queued re-dispatch
+
+    _parked_cap = 64
+
+    def _park_redispatch(self, ent: dict) -> None:
+        """Re-dispatch one journaled QUEUED-but-unstarted record on a
+        background thread, buffering its tokens under the rid; the
+        client's retry (same rid, SUBMIT or STREAM) attaches to the
+        buffer instead of double-submitting.  Bounded by
+        ``_parked_cap`` — records past it stay orphans (their clients
+        re-issue with resume, the pre-existing recovery window)."""
+        rid = str(ent["rid"])
+        with self._parked_cv:
+            if (rid in self._parked
+                    or len(self._parked) >= self._parked_cap):
+                return
+            slot = {"toks": [], "done": False, "err": None}
+            self._parked[rid] = slot
+        self._bump(QUEUED_REDISPATCHES)
+
+        def _run():
+            try:
+                for tok in self.stream(
+                        np.asarray(ent["p"], np.int32),
+                        int(ent["mnt"]),
+                        seed=int(ent.get("seed") or 0),
+                        priority=int(ent.get("prio") or 0),
+                        tenant=ent.get("tenant"), slo=ent.get("slo"),
+                        rid=rid, _redispatch=True):
+                    with self._parked_cv:
+                        slot["toks"].append(int(tok))
+                        self._parked_cv.notify_all()
+            except BaseException as e:  # delivered to the attacher
+                slot["err"] = f"{type(e).__name__}: {e}"
+            finally:
+                with self._parked_cv:
+                    slot["done"] = True
+                    self._parked_cv.notify_all()
+
+        threading.Thread(target=_run, daemon=True,
+                         name=f"bps-requeue-{rid}").start()
+
+    def _attach_parked(self, rid: str, slot: dict,
+                       emitted: List[int], deadline_ts: float):
+        """Yield the parked re-dispatch's tokens past the caller's
+        resume offset; the slot is consumed when the underlying
+        stream completes (accounting — REQUESTS/COMPLETED/journal —
+        belongs to the re-dispatch run, not this view)."""
+        i = len(emitted)
+        while True:
+            with self._parked_cv:
+                while (len(slot["toks"]) <= i and not slot["done"]
+                        and time.monotonic() < deadline_ts):
+                    self._parked_cv.wait(min(
+                        0.1, max(0.001,
+                                 deadline_ts - time.monotonic())))
+                toks = list(slot["toks"])
+                done = bool(slot["done"])
+                err = slot["err"]
+            while i < len(toks):
+                yield int(toks[i])
+                i += 1
+            if done and i >= len(toks):
+                with self._parked_cv:
+                    self._parked.pop(rid, None)
+                if err:
+                    raise ReplicaLostError(
+                        f"takeover re-dispatch of {rid} failed: "
+                        f"{err}", emitted=toks)
+                return
+            if time.monotonic() >= deadline_ts:
+                raise ReplicaLostError(
+                    f"takeover re-dispatch of {rid} still running at "
+                    f"the caller's deadline", emitted=toks)
 
     # ------------------------------------------------------------ inspection
 
@@ -1630,7 +1971,9 @@ class ServeRouter:
                      TAKEOVERS, DEMOTIONS, STANDBY_REFUSED, CANCELS,
                      CANCELLED, JOURNAL_SENT, JOURNAL_APPLIED,
                      TAKEOVER_ORPHANS, DISAGG_PREFILLS,
-                     DISAGG_SHIPPED_BLOCKS, DISAGG_FALLBACKS):
+                     DISAGG_SHIPPED_BLOCKS, DISAGG_FALLBACKS,
+                     SHED_GUARANTEED, SHED_STANDARD, SHED_BEST_EFFORT,
+                     BORROWED_CREDITS, QUEUED_REDISPATCHES):
             m = self._registry.get(name)
             out[name] = m.value if m is not None else 0
         return out
@@ -1667,7 +2010,8 @@ class _RouterHandler(socketserver.BaseRequestHandler):
                             priority=int(params.get("priority", 0)),
                             resume=resumed,
                             rid=params.get("rid"),
-                            tenant=params.get("tenant"))
+                            tenant=params.get("tenant"),
+                            slo=params.get("slo"))
                         mnt = int(params.get("max_new_tokens", 16))
                     if op == OP_SUBMIT:
                         new = router.generate(prompt, mnt, **kw)
@@ -1877,6 +2221,34 @@ def router_from_env(env=None) -> int:
         peers=peers or None,
         self_addr=cfg.router_self,
         epoch_timeout=cfg.router_epoch_timeout_ms / 1e3,
-        tenant_weights=tenant_weights or None)
-    serve_router(router, cfg.router_port)
+        tenant_weights=tenant_weights or None,
+        slo_default=cfg.slo_default,
+        slo_deadlines={
+            SLO_STANDARD: cfg.slo_standard_deadline_ms / 1e3,
+            SLO_BEST_EFFORT: cfg.slo_best_effort_deadline_ms / 1e3},
+        service_estimate_s=cfg.slo_service_estimate_ms / 1e3,
+        slo_borrow=cfg.slo_borrow)
+    controller = None
+    if cfg.autoscale:
+        from .autoscale import (AutoscaleController, ReplicaLauncher,
+                                ScalePolicy, TierSignals, poll_router)
+        controller = AutoscaleController(
+            router,
+            ScalePolicy(
+                min_replicas=cfg.autoscale_min,
+                max_replicas=cfg.autoscale_max,
+                up_threshold=cfg.autoscale_up,
+                down_threshold=cfg.autoscale_down,
+                up_cooldown_s=cfg.autoscale_up_cooldown_ms / 1e3,
+                down_cooldown_s=cfg.autoscale_down_cooldown_ms / 1e3,
+                dry_run=cfg.autoscale_dry_run),
+            TierSignals(poll_router(router),
+                        window_s=cfg.autoscale_window_ms / 1e3),
+            ReplicaLauncher(),
+            interval_s=cfg.autoscale_interval_ms / 1e3).start()
+    try:
+        serve_router(router, cfg.router_port)
+    finally:
+        if controller is not None:
+            controller.close()
     return 0

@@ -1,0 +1,658 @@
+"""Elastic capacity subsystem (byteps_tpu/serving/autoscale/).
+
+Fast tier-1 coverage for the PR 18 control loop, each half on its own
+injected seam (docs/serving.md "Elastic capacity & SLO classes"):
+
+  * ``ScalePolicy`` on scripted load traces with an injected clock —
+    hysteresis band, target-tracking up jumps, per-direction cooldowns,
+    clamps outranking cooldowns, dry-run pacing — zero sleeps (the
+    chaos harness ``--load-spike`` drives the same policy live).
+  * ``TierSignals`` on scripted polls: load folding (queue depth, KV
+    pressure floor), window eviction, mean smoothing.
+  * ``AdmissionController`` shed math (``est = backlog x service /
+    capacity``), the typed retryable ``OverloadShedError``, and the
+    service-time EWMA.
+  * ``TenantShares`` work-conserving borrow/clawback over real
+    ``ScheduledQueue`` pools at a 10:1 share ratio — strict shares stay
+    the floor, idle credits are lent, clawback flags the youngest
+    reclaimable loan and the credit flows home.
+  * ``AutoscaleController.step`` against a fake router/launcher —
+    journaled intent/done ordering, spawn-failure abort, LIFO retire,
+    and the three ``reconcile_takeover`` verdicts.
+  * The router-level anchors: deadline shedding at the door of a real
+    tier, and journal-driven re-dispatch of a QUEUED-but-unstarted
+    request at router takeover (the request is parked in the admission
+    queue when the active dies; the standby re-runs it from the
+    journaled prompt and the client's retry attaches token-identically).
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from byteps_tpu.common.scheduler import ScheduledQueue
+from byteps_tpu.inference import generate
+from byteps_tpu.models.transformer import Transformer, TransformerConfig
+from byteps_tpu.observability.metrics import MetricsRegistry
+from byteps_tpu.resilience.policy import RetryPolicy
+from byteps_tpu.serving import (
+    AutoscaleController,
+    OverloadShedError,
+    ReplicaLauncher,
+    ScalePolicy,
+    ServeMetrics,
+    ServeRouter,
+    ServingEngine,
+    TenantShares,
+    TierSignals,
+    normalize_slo,
+)
+from byteps_tpu.serving import router as rt
+from byteps_tpu.serving.autoscale.actuator import ReplicaHandle
+from byteps_tpu.serving.autoscale.admission import (
+    SLO_BEST_EFFORT,
+    SLO_CLASSES,
+    SLO_GUARANTEED,
+    AdmissionController,
+)
+from byteps_tpu.serving.autoscale.signals import SignalSample
+from byteps_tpu.serving.frontend import serve
+from byteps_tpu.serving.scheduler import AdmissionError
+
+M = 8  # tokens per request (shared so generate() compiles once)
+
+
+# ------------------------------------------------------------- slo classes
+
+
+def test_normalize_slo_classes_and_typed_unknown():
+    assert normalize_slo(None) == "standard"
+    assert normalize_slo("") == "standard"
+    assert normalize_slo(None, default=SLO_BEST_EFFORT) == "best-effort"
+    assert normalize_slo("Guaranteed") == "guaranteed"
+    assert normalize_slo("BEST_EFFORT") == "best-effort"  # wire spelling
+    assert normalize_slo("  standard  ") == "standard"
+    with pytest.raises(AdmissionError, match="platinum"):
+        normalize_slo("platinum")  # a typo must not become standard
+
+
+def test_overload_shed_error_typed_and_retryable():
+    e = OverloadShedError("best-effort", 2.5, 1.0)
+    assert isinstance(e, AdmissionError)
+    assert e.retryable  # the client contract: back off and re-issue
+    assert e.slo == "best-effort"
+    assert e.est_wait_s == 2.5 and e.deadline_s == 1.0
+    assert "2.50" in str(e) and "best-effort" in str(e)
+    assert "clawed" in str(OverloadShedError(
+        "best-effort", 0.0, 0.0, reason="borrowed credit clawed back"))
+
+
+# -------------------------------------------------------- admission control
+
+
+def test_admission_wait_estimate_and_shed_math():
+    adm = AdmissionController(service_estimate_s=2.0)
+    # under capacity: the next arrival does not wait
+    assert adm.estimate_wait(inflight=2, queued=0, capacity=4) == 0.0
+    # backlog of 3 past capacity, draining 4 at a time, 2 s per round
+    assert adm.estimate_wait(inflight=4, queued=2, capacity=4) == \
+        pytest.approx(3 * 2.0 / 4)
+    # best-effort (1 s default deadline) sheds; guaranteed never does
+    with pytest.raises(OverloadShedError) as ei:
+        adm.admit(SLO_BEST_EFFORT, inflight=4, queued=2, capacity=4)
+    assert ei.value.est_wait_s == pytest.approx(1.5)
+    assert adm.shed_count[SLO_BEST_EFFORT] == 1
+    assert adm.admit(SLO_GUARANTEED, 40, 40, 4) >= 0.0
+    assert adm.shed_count[SLO_GUARANTEED] == 0
+    # standard's default 10 s deadline admits the same backlog
+    assert adm.admit("standard", 4, 2, 4) == pytest.approx(1.5)
+
+
+def test_admission_service_ewma_tracks_completions():
+    adm = AdmissionController(service_estimate_s=1.0)
+    adm.note_service(3.0)  # alpha=0.2: 1.0 + 0.2*(3.0-1.0)
+    assert adm.service_estimate_s == pytest.approx(1.4)
+    adm.note_service(3.0)
+    assert adm.service_estimate_s == pytest.approx(1.72)
+    # the estimate feeds straight into the wait math
+    assert adm.estimate_wait(2, 0, 1) == pytest.approx(2 * 1.72)
+
+
+def test_admission_custom_deadlines_override_defaults():
+    adm = AdmissionController(deadlines={SLO_GUARANTEED: 0.5},
+                              service_estimate_s=1.0)
+    with pytest.raises(OverloadShedError):
+        adm.admit(SLO_GUARANTEED, inflight=2, queued=0, capacity=1)
+    assert set(adm.shed_count) == set(SLO_CLASSES)
+
+
+# ------------------------------------------------------------ scale policy
+
+
+def test_scale_policy_scripted_trace_hysteresis_and_cooldowns():
+    """The deterministic sibling of the chaos ``--load-spike`` leg: the
+    same policy object the live controller drives, on a scripted trace
+    with an injected clock — no sleeps, no engines."""
+    p = ScalePolicy(min_replicas=1, max_replicas=4, up_threshold=0.8,
+                    down_threshold=0.3, up_cooldown_s=5.0,
+                    down_cooldown_s=15.0)
+    # in the hysteresis band: hold
+    d = p.decide(0.5, current=2, now=0.0)
+    assert d.action == "hold" and d.target == 2 and not d.acts
+    # target tracking: a 4x spike jumps capacity in ONE decision
+    d = p.decide(3.2, current=1, now=1.0)
+    assert d.action == "up" and d.target == 4 and d.acts
+    # up cooldown: continued pressure inside 5 s holds (current=2:
+    # the spawn is still catching up to the target)...
+    d = p.decide(2.0, current=2, now=2.0)
+    assert d.action == "hold" and "cooldown" in d.reason
+    # ...and a tier already at max_replicas holds under any load
+    d = p.decide(9.9, current=4, now=3.0)
+    assert d.action == "hold" and d.target == 4
+    # scale-down: pinned by the down cooldown measured from the LAST
+    # move in either direction (the up at now=1.0)
+    d = p.decide(0.1, current=4, now=10.0)
+    assert d.action == "hold" and "cooldown" in d.reason
+    d = p.decide(0.1, current=4, now=16.5)
+    assert d.action == "down" and d.target == 3  # one at a time
+    # and the down itself re-arms the cooldown
+    d = p.decide(0.1, current=3, now=17.0)
+    assert d.action == "hold" and "cooldown" in d.reason
+    # min_replicas floors the tier
+    d = p.decide(0.0, current=1, now=1000.0)
+    assert d.action == "hold" and d.target == 1
+
+
+def test_scale_policy_clamps_outrank_thresholds_and_cooldowns():
+    p = ScalePolicy(min_replicas=2, max_replicas=3, up_cooldown_s=1e9,
+                    down_cooldown_s=1e9)
+    # below min: scale up regardless of load or cooldown state
+    d = p.decide(0.0, current=1, now=0.0)
+    assert d.action == "up" and d.target == 2 and "min_replicas" in d.reason
+    # above max: scale down regardless (e.g. config lowered live)
+    d = p.decide(5.0, current=5, now=0.0)
+    assert d.action == "down" and d.target == 3
+
+
+def test_scale_policy_dry_run_paces_like_live():
+    p = ScalePolicy(up_threshold=0.8, up_cooldown_s=5.0, dry_run=True)
+    d = p.decide(1.5, current=1, now=0.0)
+    assert d.action == "up" and d.dry_run and not d.acts
+    # the rehearsal must pace exactly like the live loop: the dry-run
+    # decision still stamps the cooldown
+    d = p.decide(1.5, current=1, now=1.0)
+    assert d.action == "hold" and "cooldown" in d.reason
+
+
+def test_scale_policy_accepts_aggregate_or_float():
+    p = ScalePolicy()
+    s = SignalSample(inflight=3, capacity=2, queued=1)
+    assert p.decide(s, 1, 0.0).action == "up"  # .load attribute
+    with pytest.raises(ValueError):
+        ScalePolicy(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        ScalePolicy(up_threshold=0.3, down_threshold=0.8)
+
+
+# ------------------------------------------------------------ tier signals
+
+
+def test_tier_signals_load_folding_and_window():
+    # load = (inflight + queued) / capacity, floored by KV pressure
+    assert SignalSample(2, 4).load == pytest.approx(0.5)
+    assert SignalSample(4, 4, queued=4).load == pytest.approx(2.0)
+    assert SignalSample(0, 4, kv_blocks_free=1,
+                        kv_blocks_total=10).load == pytest.approx(0.9)
+    assert SignalSample(3, 0).load == pytest.approx(3.0)  # cap floor 1
+
+    trace = [SignalSample(0, 2), SignalSample(2, 2, queued=2),
+             SignalSample(2, 2, queued=4, ttft_p99_s=0.7)]
+    sig = TierSignals(lambda: trace.pop(0), window_s=10.0)
+    assert sig.sample(now=0.0).load == pytest.approx(0.0)
+    assert sig.sample(now=1.0).load == pytest.approx(1.0)  # mean(0, 2)
+    agg = sig.sample(now=2.0)
+    assert agg.load == pytest.approx((0.0 + 2.0 + 3.0) / 3)
+    assert agg.n_samples == 3 and agg.queued == 4
+    assert agg.utilization == pytest.approx(1.0)  # latest inflight/cap
+    assert agg.ttft_p99_s == pytest.approx(0.7)   # max over window
+
+
+def test_tier_signals_window_eviction():
+    sig = TierSignals(lambda: SignalSample(1, 1), window_s=5.0)
+    sig.sample(now=0.0)
+    sig.sample(now=1.0)
+    assert sig.sample(now=4.0).n_samples == 3
+    # now=7: the now=0 and now=1 samples age out of the 5 s window
+    assert sig.sample(now=7.0).n_samples == 2
+    assert sig.aggregate().n_samples == 2
+
+
+# ---------------------------------------------------- work-conserving shares
+
+
+def _pool(credits, name):
+    return ScheduledQueue(scheduled=True, credit_bytes=credits, name=name)
+
+
+def test_tenant_shares_borrow_and_clawback_10_to_1():
+    """The work-conserving contract on a 10:1 apportionment: the small
+    tenant's strict share is the floor, the big tenant's idle credits
+    are lent, and clawback flags the youngest reclaimable loan so the
+    credit flows home — all deterministic, no router."""
+    pools = {"big": _pool(10, "t.big"), "small": _pool(1, "t.small")}
+    shares = TenantShares(pools)
+    # small uses its own share first, then borrows from idle big
+    own = shares.acquire("small")
+    assert own is not None and not own.borrowed
+    loan = shares.acquire("small", reclaimable=True)
+    assert loan is not None and loan.borrowed and loan.lender == "big"
+    assert pools["big"].credits == 9
+    assert shares.borrowed_total == 1
+    assert shares.outstanding_loans("big") == 1
+    # big drains its remaining 9 — strict share minus the loan
+    big = [shares.acquire("big") for _ in range(9)]
+    assert all(l is not None and not l.borrowed for l in big)
+    assert pools["big"].credits == 0
+    # big starves: clawback flags small's reclaimable loan (the
+    # stream-side shed is the router's job; here the flag IS the test)
+    assert shares.clawback("big") == 1
+    assert loan.reclaimed and shares.clawbacks_total == 1
+    # the shed stream releases: the credit flows to the LENDER
+    shares.release(loan)
+    assert pools["big"].credits == 1
+    assert shares.outstanding_loans("big") == 0
+    got = shares.acquire("big", timeout=0.0)
+    assert got is not None and not got.borrowed
+    # releases drain cleanly back to the configured shares
+    for l in [own, got] + big:
+        shares.release(l)
+    assert pools["big"].credits == 10 and pools["small"].credits == 1
+
+
+def test_tenant_shares_blocked_acquire_claws_loan_home():
+    """The live wake path: a starved lender BLOCKS in acquire, its wait
+    loop claws the loan back, and the borrower's release wakes it
+    within one 50 ms wait chunk — the 'one control interval' bound."""
+    pools = {"big": _pool(1, "t.big2"), "small": _pool(1, "t.small2")}
+    shares = TenantShares(pools)
+    loan = shares.acquire("small", reclaimable=True)  # small's own
+    loan2 = shares.acquire("small", reclaimable=True)
+    assert loan2 is not None and loan2.lender == "big"
+    got = {}
+
+    def _starved():
+        got["lease"] = shares.acquire("big", timeout=5.0)
+
+    t = threading.Thread(target=_starved, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while not loan2.reclaimed and time.monotonic() < deadline:
+        time.sleep(0.005)  # the blocked acquire flags it
+    assert loan2.reclaimed
+    shares.release(loan2)  # the shed borrower returns the credit
+    t.join(5.0)
+    assert not t.is_alive()
+    assert got["lease"] is not None and not got["lease"].borrowed
+    assert shares.waiters("big") == 0
+    shares.release(got["lease"])
+    shares.release(loan)
+
+
+def test_tenant_shares_floor_and_refusals():
+    pools = {"a": _pool(1, "t.a"), "b": _pool(1, "t.b")}
+    # borrow disabled: strict PR 14 semantics, acquire times out
+    strict = TenantShares(pools, borrow=False)
+    a1 = strict.acquire("a")
+    assert strict.acquire("a", timeout=0.05) is None
+    assert strict.borrowed_total == 0
+    strict.release(a1)
+    # should_abort cuts the blocked wait (the cancel path)
+    a1 = strict.acquire("a")
+    assert strict.acquire("a", timeout=5.0,
+                          should_abort=lambda: True) is None
+    strict.release(a1)
+    # a tenant with no configured pool is never gated (free lease)
+    free = strict.acquire("nobody")
+    assert free is not None and not free.borrowed
+    strict.release(free)  # no-op, must not credit anything
+    assert pools["a"].credits == 1 and pools["b"].credits == 1
+    # non-reclaimable loans are never clawed (guaranteed borrowers)
+    lend = TenantShares(pools)
+    a1 = lend.acquire("a")
+    loan = lend.acquire("a", reclaimable=False)
+    assert loan is not None and loan.borrowed
+    assert lend.clawback("b") == 0 and not loan.reclaimed
+    lend.release(loan)
+    lend.release(a1)
+
+
+def test_tenant_shares_never_lends_to_a_waiting_pool():
+    """A pool with live waiters is not a lending candidate — its free
+    credit (e.g. just released, waiter not yet woken) belongs to the
+    waiter, not to another tenant's overflow.  The waiter count is
+    pinned directly: the live window where a pool holds both a credit
+    and a waiter is a scheduling race, which is exactly why the guard
+    must not depend on winning it."""
+    pools = {"a": _pool(1, "t.a3"), "b": _pool(1, "t.b3")}
+    shares = TenantShares(pools)
+    a1 = shares.acquire("a")  # a's own pool now empty
+    with shares._lock:
+        shares._waiters["b"] = 1
+    # a's overflow may NOT borrow b's credit out from under b's waiter
+    assert shares.acquire("a", timeout=0.05) is None
+    assert pools["b"].credits == 1 and shares.borrowed_total == 0
+    with shares._lock:
+        shares._waiters["b"] = 0
+    loan = shares.acquire("a", timeout=0.0)  # now b is idle: lendable
+    assert loan is not None and loan.lender == "b"
+    shares.release(loan)
+    shares.release(a1)
+
+
+# -------------------------------------------------- controller on fake seams
+
+
+class _FakeRouter:
+    """The actuator's router surface, recorded: placeable count, scale
+    journal entries, add/drain calls, and a scriptable pending intent."""
+
+    def __init__(self, placeable=1):
+        self._placeable = placeable
+        self._registry = MetricsRegistry()
+        self.journal = []
+        self.added = []
+        self.drained = []
+        self._pending = None
+        self._roster = {}
+
+    def placeable_count(self):
+        return self._placeable
+
+    def add_replica(self, addr, role="both"):
+        idx = len(self.added)
+        self.added.append(addr)
+        self._roster[addr] = idx
+        self._placeable += 1
+        return idx
+
+    def drain(self, idx, timeout=None):
+        self.drained.append(idx)
+        self._placeable -= 1
+
+    def journal_scale(self, op, addr=None, idx=None, phase="intent"):
+        self.journal.append((op, addr, phase))
+        self._pending = ({"op": op, "addr": addr}
+                         if phase == "intent" else None)
+
+    def pending_scale(self):
+        return dict(self._pending) if self._pending else None
+
+    def replica_index(self, addr):
+        return self._roster.get(addr)
+
+
+def _controller(router, spawn_addrs, **pol):
+    pool = list(spawn_addrs)
+    stopped = []
+    launcher = ReplicaLauncher(
+        spawn_fn=lambda: ReplicaHandle(pool.pop(0)),
+        stop_fn=stopped.append)
+    pol.setdefault("up_cooldown_s", 0.0)
+    pol.setdefault("down_cooldown_s", 0.0)
+    ctl = AutoscaleController(
+        router, ScalePolicy(1, 4, 0.8, 0.3, **pol),
+        TierSignals(lambda: SignalSample(*router._signal), window_s=0.0),
+        launcher, interval_s=0.01)
+    return ctl, stopped
+
+
+def test_controller_step_scales_up_down_journaled():
+    r = _FakeRouter(placeable=1)
+    ctl, stopped = _controller(r, ["h:1", "h:2", "h:3"])
+    r._signal = (2, 1, 0)  # inflight=2, cap=1 -> load 2.0
+    d = ctl.step(now=0.0)
+    # target tracking: ceil(1 * 2.0 / 0.8) = 3 -> spawn two at once
+    assert d.action == "up" and d.target == 3
+    assert r.added == ["h:1", "h:2"] and ctl.scale_ups == 2
+    assert r.placeable_count() == 3
+    # journal ordering per spawn: intent (no addr yet) then done
+    assert r.journal == [("up", None, "intent"),
+                         ("up", "h:1", "done"),
+                         ("up", None, "intent"),
+                         ("up", "h:2", "done")]
+    assert r.pending_scale() is None  # every intent was closed
+    # idle: retire ONE per decision, LIFO, launcher-spawned only
+    r.journal.clear()
+    r._signal = (0, 3, 0)
+    d = ctl.step(now=1.0)
+    assert d.action == "down" and d.target == 2
+    assert r.drained == [1] and stopped[0].addr == "h:2"
+    assert ctl.scale_downs == 1
+    assert r.journal == [("down", "h:2", "intent"),
+                         ("down", "h:2", "done")]
+    d = ctl.step(now=2.0)
+    assert r.drained == [1, 0] and stopped[1].addr == "h:1"
+    assert ctl.scale_downs == 2
+    # back at the static seed replica: nothing launcher-owned remains,
+    # so a further retire is a refusal, not a drain of the seed
+    ctl._scale_down(1)
+    assert ctl.scale_downs == 2 and r.drained == [1, 0]
+    # metrics: the gauge tracks the tier, the counter the events
+    assert r._registry.get("autoscale.replicas").value == 1
+    assert r._registry.get("autoscale.scale_events").value == 4
+
+
+def test_controller_spawn_failure_journals_abort():
+    r = _FakeRouter(placeable=1)
+    ctl, _ = _controller(r, [])  # pool empty -> spawn raises IndexError
+    r._signal = (2, 1, 0)
+    with pytest.raises(IndexError):
+        ctl.step(now=0.0)
+    assert ctl.spawn_failures == 1 and ctl.scale_ups == 0
+    assert r.journal == [("up", None, "intent"), ("up", None, "abort")]
+    assert r.pending_scale() is None
+
+
+def test_controller_reconcile_takeover_verdicts():
+    # no pending intent
+    r = _FakeRouter()
+    ctl, _ = _controller(r, [])
+    assert ctl.reconcile_takeover() is None
+    # mid-scale-up, replica already in the roster: adopt + close
+    r.add_replica("h:9")
+    r._pending = {"op": "up", "addr": "h:9"}
+    assert ctl.reconcile_takeover() == "adopted"
+    assert r.journal[-1] == ("up", "h:9", "done")
+    assert ctl._dynamic and ctl._dynamic[-1].idx == 0
+    # mid-scale-up, spawn never registered: drop the intent
+    r._pending = {"op": "up", "addr": "h:404"}
+    assert ctl.reconcile_takeover() == "dropped"
+    assert r.journal[-1] == ("up", "h:404", "abort")
+    # mid-scale-down: finish the drain (idempotent on the router side)
+    r._pending = {"op": "down", "addr": "h:9"}
+    assert ctl.reconcile_takeover() == "drained"
+    assert r.drained == [0]
+
+
+# --------------------------------------------------- router-level anchors
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = TransformerConfig(vocab_size=61, num_layers=2, num_heads=2,
+                            d_model=32, d_ff=64, max_seq_len=64,
+                            dtype=jnp.float32)
+    model = Transformer(cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(0), (1, 8), 0, 61)
+    variables = model.init(jax.random.PRNGKey(1), toks)
+    return cfg, model, variables
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    return [np.asarray(jax.random.randint(
+        jax.random.PRNGKey(40 + i), (5 + i,), 0, 61), np.int32)
+        for i in range(3)]
+
+
+@pytest.fixture(scope="module")
+def greedy_base(tiny, prompts):
+    _, model, variables = tiny
+    return [np.asarray(generate(model, variables, p[None], M,
+                                temperature=0.0)["tokens"])[0]
+            for p in prompts]
+
+
+def _fast_retry():
+    return RetryPolicy(max_attempts=5, backoff_base=0.02, jitter=0.0,
+                       backoff_cap=0.1, deadline=0.0)
+
+
+def test_router_sheds_best_effort_at_door_typed(tiny, prompts,
+                                                greedy_base):
+    """Deadline-aware shedding on a REAL saturated tier: with the one
+    credit held by a live stream, a best-effort arrival's estimated
+    wait blows its deadline and it sheds typed at the door — while a
+    guaranteed arrival queues and completes token-identically (the
+    entire point of shedding best-effort)."""
+    _, model, variables = tiny
+    engine = ServingEngine(model, variables, n_slots=4, max_seq=64,
+                           temperature=0.0, metrics=ServeMetrics())
+    srv = serve(engine, 0, host="127.0.0.1", in_thread=True)[0]
+    addr = "127.0.0.1:%d" % srv.server_address[1]
+    router = ServeRouter([addr], affinity=False, credits=1,
+                         deadline=20.0, stream_timeout=5.0,
+                         retry=_fast_retry(), registry=MetricsRegistry(),
+                         slo_deadlines={"best-effort": 1.0},
+                         service_estimate_s=10.0).start()
+    try:
+        held = router.stream(prompts[0], M)
+        assert int(next(held)) == int(greedy_base[0][0])
+        # tier signals see the saturation the admission gate reads
+        snap = router.signal_snapshot()
+        assert snap["capacity"] == 1 and snap["inflight"] == 1
+        assert router.placeable_count() == 1
+        # est = (1+0+1-1) * 10.0 / 1 = 10 s > 1 s best-effort deadline
+        with pytest.raises(OverloadShedError) as ei:
+            list(router.stream(prompts[1], M, slo="best-effort"))
+        assert ei.value.retryable and ei.value.slo == "best-effort"
+        st = router.stats()
+        assert st[rt.SHED_BEST_EFFORT] == 1
+        assert st[rt.SHED_GUARANTEED] == 0
+        # unknown class: typed at the door, nothing placed
+        with pytest.raises(AdmissionError, match="platinum"):
+            list(router.stream(prompts[1], M, slo="platinum"))
+        # guaranteed queues behind the held credit and completes
+        assert list(held)[-1] == int(greedy_base[0][-1])
+        toks = list(router.stream(prompts[1], M, slo="guaranteed"))
+        assert toks == [int(t) for t in greedy_base[1]]
+    finally:
+        router.close()
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_takeover_redispatches_parked_queued_request(tiny, prompts,
+                                                     greedy_base):
+    """Satellite (a), the HA seam of the elastic tier: a request that
+    was admitted but never PLACED (parked at the fair-share gate) when
+    the active router dies is re-dispatched by the standby from its
+    journaled prompt, and the client's retry (same rid) attaches to
+    the parked stream token-identically instead of double-submitting."""
+    from byteps_tpu.engine.transport import free_port
+    from byteps_tpu.serving.router import RouterFrontend
+
+    _, model, variables = tiny
+    engine = ServingEngine(model, variables, n_slots=4, max_seq=64,
+                           temperature=0.0, metrics=ServeMetrics())
+    srv = serve(engine, 0, host="127.0.0.1", in_thread=True)[0]
+    rep_addr = "127.0.0.1:%d" % srv.server_address[1]
+    pa, pb = free_port(), free_port()
+    peers = [f"127.0.0.1:{pa}", f"127.0.0.1:{pb}"]
+
+    def mk(self_addr):
+        # tenant "t" gets ONE credit; borrowing off so the second
+        # stream parks at the gate instead of borrowing default's
+        return ServeRouter(
+            [rep_addr], affinity=False, credits=2, deadline=20.0,
+            stream_timeout=5.0, heartbeat_interval=0.1,
+            miss_threshold=2, ping_timeout=0.5, retry=_fast_retry(),
+            registry=MetricsRegistry(), peers=peers,
+            self_addr=self_addr, epoch_timeout=0.1,
+            tenant_weights={"t": 1.0}, slo_borrow=False)
+
+    ra, rb = mk(peers[0]), mk(peers[1])
+    fa = RouterFrontend(("127.0.0.1", pa), ra)
+    fb = RouterFrontend(("127.0.0.1", pb), rb)
+    for f in (fa, fb):
+        threading.Thread(target=f.serve_forever, daemon=True).start()
+    held = None
+    try:
+        assert ra.active and not rb.active
+        # stream 1 HOLDS tenant t's single credit mid-flight
+        held = ra.stream(prompts[0], M, tenant="t", rid="held")
+        next(held)
+        # stream 2 journals its QUEUED record (prompt included), then
+        # parks at the fair-share gate — admitted, never placed
+        def _parked():
+            try:
+                list(ra.stream(prompts[1], M, tenant="t", rid="parkme",
+                               slo="guaranteed"))
+            except Exception:
+                pass  # cancelled at cleanup / deposed mid-wait
+        threading.Thread(target=_parked, daemon=True).start()
+        assert ra._journal is not None and ra._journal.flush(5.0)
+        deadline = time.monotonic() + 5.0
+        ent = {}
+        while time.monotonic() < deadline:
+            ents = {e.get("rid"): e
+                    for e in list(rb._journal_inflight.values())}
+            ent = ents.get("parkme") or {}
+            if ent.get("p") and ents.get("held", {}).get("r") is not None:
+                break
+            time.sleep(0.02)
+        assert ent.get("r") is None and not ent.get("n")
+        assert list(ent["p"]) == [int(t) for t in prompts[1]]
+        assert ent.get("slo") == "guaranteed" and ent.get("tenant") == "t"
+        # the active dies with the request still parked
+        fa.kill()
+        deadline = time.monotonic() + 10.0
+        while not rb.active and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert rb.active and rb.epoch == 2
+        ra.cancel("parkme")  # the deposed router must not re-place it
+        # rb.active flips early inside _takeover (under the lock); the
+        # orphan accounting and the parked re-dispatch land later in
+        # the same call, after the detector start and the journal
+        # hello — poll for them instead of racing that window
+        deadline = time.monotonic() + 10.0
+        st = rb.stats()
+        while (st.get(rt.QUEUED_REDISPATCHES, 0) < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+            st = rb.stats()
+        assert st[rt.TAKEOVERS] == 1
+        # the QUEUED record was re-dispatched by the new active; the
+        # placed one ("held") is an orphan (client-side resume window)
+        assert st[rt.QUEUED_REDISPATCHES] == 1
+        assert st[rt.TAKEOVER_ORPHANS] == 1
+        # the client's retry attaches by rid — token-identical, and
+        # accounting stays with the re-dispatch run (no double-submit)
+        toks = list(rb.stream(prompts[1], M, rid="parkme", tenant="t"))
+        assert toks == [int(t) for t in greedy_base[1]]
+        assert "parkme" not in rb._parked  # slot consumed
+        # the tier keeps serving normally on the survivor
+        toks = list(rb.stream(prompts[2], M, tenant="t"))
+        assert toks == [int(t) for t in greedy_base[2]]
+    finally:
+        if held is not None:
+            held.close()
+        ra.close()
+        rb.close()
+        fb.kill()
+        srv.shutdown()
+        srv.server_close()

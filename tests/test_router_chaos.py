@@ -143,6 +143,52 @@ def test_bench_serve_disagg_mixed_no_mismatch(tmp_path):
 
 
 @pytest.mark.slow
+def test_router_chaos_load_spike():
+    """The elastic-capacity chaos leg (ISSUE 18 acceptance): a 1x ->
+    4x -> 1x load wave against a live autoscaling controller — the
+    tier grows under the spike and drains back to one replica, every
+    guaranteed request completes token-identical (never shed), every
+    best-effort request completes or sheds typed, zero hangs.  The
+    fast deterministic sibling (the same ScalePolicy on scripted
+    traces, zero sleeps) lives in tests/test_autoscale.py."""
+    import router_chaos
+
+    stats = router_chaos.run_load_spike(seed=0, verbose=False)
+    # run_load_spike() already asserts the contract; pin the headline
+    # numbers here so a silent weakening cannot pass
+    assert stats["mismatches"] == 0
+    assert stats["untyped_failures"] == 0
+    assert stats["hangs"] == 0
+    assert stats["shed_guaranteed"] == 0
+    assert stats["scale_ups"] >= 1 and stats["scale_downs"] >= 1
+    assert stats["spike_replicas"] > 1
+    assert stats["final_replicas"] == 1
+    assert (stats["best_effort_ok"] + stats["best_effort_shed"]
+            + stats["guaranteed_ok"] == stats["requests"])
+
+
+@pytest.mark.slow
+def test_bench_autoscale_spike(tmp_path):
+    """The elasticity bench row: the elastic leg scales 1 -> >1 -> 1,
+    sheds ZERO guaranteed requests, sheds strictly fewer best-effort
+    requests than the fixed single-replica leg under the same
+    sustained spike, and keeps the guaranteed spike p99 no worse than
+    fixed — elasticity converts would-be sheds into completions
+    without paying for it in the guaranteed tail."""
+    import bench_serve
+
+    row = bench_serve.autoscale_spike(
+        out_path=str(tmp_path / "BENCH_SERVE.json"))
+    el, fx = row["autoscale"], row["fixed"]
+    assert el["untyped"] == 0 and fx["untyped"] == 0
+    assert el["scale_ups"] >= 1 and el["scale_downs"] >= 1
+    assert el["shed_guaranteed"] == 0
+    assert el["peak_replicas"] > 1 and el["final_replicas"] == 1
+    assert el["shed_best_effort"] < fx["shed_best_effort"], row
+    assert el["spike_p99_s"] <= fx["spike_p99_s"] * 1.1, row
+
+
+@pytest.mark.slow
 def test_bench_router_ha_completes_across_router_kill(tmp_path):
     """The router-HA bench row: the router-kill leg completes EVERY
     request token-identical (availability degrades to takeover-window
