@@ -547,6 +547,146 @@ def paged_kernel_ab(requests: int = 12, tokens: int = 16,
     return row
 
 
+def kv_int8_ab(long_reqs: int = 2, long_len: int = 160,
+               short_reqs: int = 14, short_len: int = 80,
+               tokens: int = 16, slots: int = 16, fp_slots: int = 2,
+               d_model: int = 256, layers: int = 2, vocab: int = 256,
+               block: int = 16, chunk: int = 32, max_seq: int = 256,
+               out_path: str = "BENCH_SERVE.json", archive: bool = True):
+    """int8-vs-fp paged A/B at a FIXED KV byte budget (the
+    ``kv_dtype="int8"`` acceptance leg, BENCH_SERVE.json
+    ``serve_kv_int8``).
+
+    Both engines are paged and get the SAME byte budget (``fp_slots``
+    full ``max_seq`` rows' worth).  The fp pool spends it on fp blocks;
+    the int8 pool stores s8 values + f32 scale rows per block
+    (docs/serving.md "int8 paged KV") so the same bytes buy >= 1.8x
+    blocks — peak concurrent in-flight requests on the mixed
+    long/short workload is the acceptance ratio.  Reported alongside:
+    a uniform all-short leg where BOTH pools are unconstrained, where
+    int8 TPOT must sit within 1.1x of fp (the dequant is a broadcast
+    multiply riding the existing attend, not a new pass), and the
+    mixed int8 leg run TWICE — int8-vs-fp token parity is NOT asserted
+    (quantization is lossy, bounded, documented), run-to-run
+    bit-exactness IS (0 mismatches across preempt/resume under
+    pressure)."""
+    cfg = TransformerConfig(
+        vocab_size=vocab, num_layers=layers, num_heads=4,
+        d_model=d_model, d_ff=4 * d_model, max_seq_len=max_seq,
+        dtype=jnp.float32)
+    model = Transformer(cfg)
+    variables = model.init(jax.random.PRNGKey(1),
+                           jnp.zeros((1, 8), jnp.int32))
+    longs = _prompts(long_reqs, long_len, vocab)
+    shorts = _prompts(short_reqs + 2, short_len, vocab)
+    mixed = shorts[:short_reqs // 2] + longs + shorts[short_reqs // 2:
+                                                     short_reqs]
+
+    def run_engine(prompts, kv_dtype, kv_blocks=None):
+        eng = ServingEngine(
+            model, variables, n_slots=slots, max_seq=max_seq,
+            temperature=0.0, max_queue=4 * len(prompts), chunk=chunk,
+            paged=True, block=block, kv_blocks=kv_blocks,
+            kv_dtype=kv_dtype, prefill_credits=slots * max_seq,
+            metrics=ServeMetrics())
+        eng.start()
+        eng.submit(shorts[-1], tokens)  # warmup: compile off-timer
+        eng.drain(timeout=600)
+        eng.submit(longs[0], tokens)
+        eng.drain(timeout=600)
+        eng.metrics = ServeMetrics()
+        peak = {"v": 0}
+        stop = threading.Event()
+
+        def sample():
+            # count requests concurrently DECODING (past prefill, not
+            # preempted back to QUEUED): block grants are lazy, so raw
+            # slot occupancy spikes above what the pool can actually
+            # sustain — decode concurrency is the capacity signal
+            while not stop.is_set():
+                live = sum(1 for r in eng._slot_req
+                           if r is not None
+                           and r.state.value == "active")
+                peak["v"] = max(peak["v"], live)
+                time.sleep(0.002)
+
+        t = threading.Thread(target=sample, daemon=True)
+        t.start()
+        t0 = time.perf_counter()
+        reqs = [eng.submit(p, tokens) for p in prompts]
+        eng.drain(timeout=600)
+        elapsed = time.perf_counter() - t0
+        stop.set()
+        t.join()
+        outs = [np.asarray(r.result()) for r in reqs]
+        summ = eng.metrics.summary()
+        counts = eng.compile_counts()
+        preempts = eng.metrics.get(sm.PREEMPTIONS)
+        block_bytes = eng.pool.block_bytes
+        eng.stop()
+        if counts["decode"] != counts["decode_buckets"]:
+            raise RuntimeError(f"decode retraced: {counts}")
+        return {"elapsed_s": round(elapsed, 4),
+                "peak_concurrent": peak["v"],
+                "preemptions": preempts,
+                "block_bytes": block_bytes,
+                "ttft_p50_ms": round(summ["ttft_p50_s"] * 1e3, 2),
+                "tpot_p50_ms": round(summ["tpot_p50_s"] * 1e3, 2),
+                "outs": outs, "compile_counts": dict(counts)}
+
+    # the shared budget, denominated in fp blocks (+ the null block)
+    fp_block_bytes = layers * 2 * block * 4 * (d_model // 4) * 4
+    budget = fp_slots * (max_seq // block) * fp_block_bytes
+    int8_block_bytes = layers * 2 * block * (
+        (d_model // 4) * 4 + 4 * 4)  # s8 values + f32 scale rows
+    fp_mixed = run_engine(mixed, "", budget // fp_block_bytes + 1)
+    q8_mixed = run_engine(mixed, "int8", budget // int8_block_bytes + 1)
+    q8_again = run_engine(mixed, "int8", budget // int8_block_bytes + 1)
+    rerun_mismatches = sum(
+        0 if np.array_equal(a, b) else 1
+        for a, b in zip(q8_mixed["outs"], q8_again["outs"]))
+    # uniform all-short leg, both pools unconstrained
+    uniform = shorts[:short_reqs]
+    fp_uni = run_engine(uniform, "")
+    q8_uni = run_engine(uniform, "int8")
+    row = {
+        "metric": "serve_kv_int8",
+        "backend": jax.default_backend(),
+        "model": {"d_model": d_model, "layers": layers, "vocab": vocab,
+                  "max_seq": max_seq, "block": block, "chunk": chunk},
+        "kv_budget_bytes": budget,
+        "fp_block_bytes": fp_mixed["block_bytes"],
+        "int8_block_bytes": q8_mixed["block_bytes"],
+        "block_bytes_ratio": round(
+            fp_mixed["block_bytes"] / q8_mixed["block_bytes"], 2),
+        "requests": len(mixed), "tokens_per_request": tokens,
+        "fp_peak_concurrent": fp_mixed["peak_concurrent"],
+        "int8_peak_concurrent": q8_mixed["peak_concurrent"],
+        "concurrency_ratio": round(
+            q8_mixed["peak_concurrent"]
+            / max(fp_mixed["peak_concurrent"], 1), 2),
+        "fp_preemptions": fp_mixed["preemptions"],
+        "int8_preemptions": q8_mixed["preemptions"],
+        "rerun_mismatches": rerun_mismatches,
+        "fp_elapsed_s": fp_mixed["elapsed_s"],
+        "int8_elapsed_s": q8_mixed["elapsed_s"],
+        "uniform_fp_tpot_p50_ms": fp_uni["tpot_p50_ms"],
+        "uniform_int8_tpot_p50_ms": q8_uni["tpot_p50_ms"],
+        "uniform_tpot_overhead": round(
+            q8_uni["tpot_p50_ms"] / max(fp_uni["tpot_p50_ms"], 1e-9)
+            - 1.0, 3),
+        "compile_counts_int8": q8_mixed["compile_counts"],
+    }
+    print(json.dumps(row))
+    if rerun_mismatches:
+        raise RuntimeError(
+            f"int8 engine is not run-to-run reproducible: "
+            f"{rerun_mismatches} mismatches")
+    if archive:
+        _archive_rows([row], out_path)
+    return row
+
+
 def spec_decode(tokens: int = 96, requests: int = 4, slots: int = 4,
                 prompt_len: int = 12, spec_k: int = 8, ngram: int = 3,
                 reps: int = 3, out_path: str = "BENCH_SERVE.json",
@@ -1427,6 +1567,11 @@ def main(argv=None) -> int:
                     help="run only the paged-vs-dense A/B at a fixed "
                          "KV-memory budget (mixed long/short workload "
                          "+ uniform TTFT/TPOT noise check)")
+    ap.add_argument("--kv-int8", action="store_true",
+                    help="run only the int8-vs-fp paged A/B at a "
+                         "fixed KV byte budget (peak concurrency "
+                         "ratio, uniform-leg TPOT overhead, run-to-"
+                         "run reproducibility)")
     ap.add_argument("--shared-len", type=int, default=96)
     ap.add_argument("--requests", type=int, default=12)
     ap.add_argument("--chunk", type=int, default=32)
@@ -1550,9 +1695,25 @@ def main(argv=None) -> int:
     # the two legs have different sweet-spot defaults; explicit flags
     # win in both
     tokens = args.tokens if args.tokens is not None else (
-        16 if args.prefix_share or args.paged else 64)
+        16 if args.prefix_share or args.paged or args.kv_int8 else 64)
     slots = args.slots if args.slots is not None else (
         8 if args.prefix_share else 16)
+    if args.kv_int8:
+        row = kv_int8_ab(tokens=tokens, slots=slots,
+                         out_path=args.out,
+                         archive=not args.no_archive)
+        ok = (row["concurrency_ratio"] >= 1.8
+              and row["uniform_tpot_overhead"] <= 0.10
+              and row["rerun_mismatches"] == 0)
+        print(f"int8 KV @ fixed budget: {row['int8_peak_concurrent']} "
+              f"vs {row['fp_peak_concurrent']} concurrent "
+              f"({row['concurrency_ratio']}x, blocks "
+              f"{row['block_bytes_ratio']}x smaller), uniform TPOT "
+              f"overhead {row['uniform_tpot_overhead'] * 100:.1f}%, "
+              f"{row['rerun_mismatches']} rerun mismatches "
+              f"({'PASS' if ok else 'FAIL'} >= 1.8x concurrency, "
+              f"<= 10% TPOT overhead, bit-exact reruns)")
+        return 0 if ok else 1
     if args.paged:
         row = paged_ab(tokens=tokens, slots=slots,
                        out_path=args.out, archive=not args.no_archive)

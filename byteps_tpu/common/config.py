@@ -171,6 +171,11 @@ class Config:
     serve_paged: bool = False     # paged KV cache (block-granular pool)
     serve_block: int = 16         # KV block size in tokens (paged)
     serve_kv_mb: int = 0          # paged KV pool budget (MiB); 0 = dense-equiv
+    # paged KV pool element dtype: "" = model dtype, "int8" = s8 blocks
+    # + per-(position, head) scale rows, dequantized inside the fused
+    # kernel at DMA time (~2x blocks at fixed serve_kv_mb; quantize-at-
+    # write determinism keeps preempt/resume and disagg bit-exact)
+    serve_kv_dtype: str = ""
     # fused paged-attention decode kernel (ops/paged_attention.py):
     # block-table-indexed KV reads, no gather copy.  auto = on for
     # paged engines on TPU, off elsewhere (the CPU fallback keeps the
@@ -391,6 +396,7 @@ class Config:
             serve_paged=_env_bool("BYTEPS_SERVE_PAGED"),
             serve_block=_env_int("BYTEPS_SERVE_BLOCK", 16),
             serve_kv_mb=_env_int("BYTEPS_SERVE_KV_MB", 0),
+            serve_kv_dtype=_env_str("BYTEPS_SERVE_KV_DTYPE", ""),
             serve_paged_kernel=_env_str("BYTEPS_SERVE_PAGED_KERNEL",
                                         "auto"),
             serve_spec=_env_bool("BYTEPS_SERVE_SPEC"),

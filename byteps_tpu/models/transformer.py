@@ -512,12 +512,33 @@ class Attention(nn.Module):
                 B_, T_ = x.shape[0], x.shape[1]
                 pk, pv = cache["k"], cache["v"]
                 wblk, woff = cache["wblk"], cache["woff"]
+                from ..ops.paged_attention import paged_decode_attention
+
+                if pk.dtype == jnp.int8:
+                    # int8 pool (kv_dtype="int8"): quantize-at-scatter —
+                    # fresh K/V lands in the pool as s8 + its per-
+                    # (position, head) scale rows, and the kernel
+                    # dequantizes in-register at DMA time.  Every read
+                    # of these positions (this step included) sees the
+                    # quantized values, so re-prefill after preempt or
+                    # disagg fallback reproduces identical pool bytes.
+                    kq, ks = _quantize_kv(k)
+                    vq, vs = _quantize_kv(v)
+                    pks, pvs = cache["k_scale"], cache["v_scale"]
+                    pk = pk.at[wblk, woff].set(kq.reshape(B_, T_, KV * D))
+                    pv = pv.at[wblk, woff].set(vq.reshape(B_, T_, KV * D))
+                    pks = pks.at[wblk, woff].set(ks.astype(pks.dtype))
+                    pvs = pvs.at[wblk, woff].set(vs.astype(pvs.dtype))
+                    out = paged_decode_attention(
+                        q, pk, pv, cache["table"], pos,
+                        k_scale=pks, v_scale=pvs,
+                        window=cfg.attn_window)
+                    return o_proj(out), dict(cache, k=pk, v=pv,
+                                             k_scale=pks, v_scale=pvs)
                 row_k = k.reshape(B_, T_, KV * D).astype(pk.dtype)
                 row_v = v.reshape(B_, T_, KV * D).astype(pv.dtype)
                 pk = pk.at[wblk, woff].set(row_k)
                 pv = pv.at[wblk, woff].set(row_v)
-                from ..ops.paged_attention import paged_decode_attention
-
                 out = paged_decode_attention(q, pk, pv, cache["table"],
                                              pos,
                                              window=cfg.attn_window)
@@ -578,7 +599,20 @@ class Attention(nn.Module):
                 elif T_ == 1:
                     from ..ops.decode_attention import decode_attention
 
-                    if quant_cache:
+                    if quant_cache and jax.default_backend() != "tpu":
+                        # off-TPU the fused kernel only interprets, and
+                        # this branch ALSO runs under per-slot vmap when
+                        # the paged engine's gather fallback attends an
+                        # int8 pool's gathered rows (the rows ARE a flat
+                        # quant cache) — interpret-mode pallas_call does
+                        # not batch.  The dense q8 path is the same
+                        # dequantize-after-read numerics.
+                        S_ = ck.shape[1]
+                        out = _cached_attention_q8(
+                            q, ck.reshape(B_, S_, KV, D), cks,
+                            cv.reshape(B_, S_, KV, D), cvs, pos,
+                            window=cfg.attn_window)
+                    elif quant_cache:
                         out = decode_attention(
                             q, ck, cv, pos, k_scale=cks, v_scale=cvs,
                             window=cfg.attn_window)
@@ -880,7 +914,11 @@ class Transformer(nn.Module):
         while the static ``pos=0`` whole-prompt path attends the exact
         pre-quantization values — chunking a quantized cache would
         silently change first-token logits (``ServingEngine`` refuses
-        the combination).
+        the combination).  The *paged* int8 pool (``kv_dtype="int8"``)
+        is the exception: there is no whole-prompt path — every attend
+        runs at a traced position against the stored s8+scale blocks —
+        so chunking an int8 paged cache is self-consistent and the
+        engine allows it (docs/serving.md "int8 paged KV").
         """
         return self.decode(tokens, caches, pos, last_idx=last_idx)
 
@@ -939,7 +977,11 @@ class Transformer(nn.Module):
                       for c in pcaches)
         logits, new = self.decode(tokens, views, pos,
                                   last_only=last_only)
-        return logits, tuple({"k": c["k"], "v": c["v"]} for c in new)
+        # strip the per-call routing (table/write targets), keep every
+        # pool leaf — int8 pools carry k_scale/v_scale alongside k/v
+        drop = ("table", "wblk", "woff")
+        return logits, tuple(
+            {n: c[n] for n in c if n not in drop} for c in new)
 
     def verify_tokens_paged_fused(self, tokens, pcaches, tables, pos,
                                   wblk, woff):

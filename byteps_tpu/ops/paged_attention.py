@@ -74,8 +74,13 @@ _NEG_INF = -1e30
 
 
 def _paged_kernel(tab_ref, pos_ref, *refs, nb: int, bs: int, tq: int,
-                  H: int, window: Optional[int]):
-    qblk_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
+                  H: int, window: Optional[int], quant: bool, cdt):
+    if quant:
+        (qblk_ref, k_ref, v_ref, ks_ref, vs_ref, oh_ref, o_ref,
+         acc_ref, m_ref, l_ref) = refs
+    else:
+        qblk_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref = refs
+        ks_ref = vs_ref = oh_ref = None
     b = pl.program_id(0)
     j = pl.program_id(1)
     pos = pos_ref[b]
@@ -97,6 +102,14 @@ def _paged_kernel(tab_ref, pos_ref, *refs, nb: int, bs: int, tq: int,
     def _step():
         qb = qblk_ref[0]                       # [Rp, KV*D]
         k = k_ref[0]                           # [BS, KV*D]
+        if quant:
+            # the s8 block streams half the pool's HBM bytes (the whole
+            # point); the VMEM-resident convert feeds the MXU at the
+            # compute dtype.  Dequant scale commutes out of the
+            # D-contraction (constant along D within a head's block)
+            # and lands on the scores below via the onehot row->group
+            # map — ops/decode_attention.py, one indirection deeper.
+            k = k.astype(cdt)
         s = jax.lax.dot_general(
             qb, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # [Rp, BS]
@@ -109,6 +122,12 @@ def _paged_kernel(tab_ref, pos_ref, *refs, nb: int, bs: int, tq: int,
         valid = kidx <= qpos
         if window is not None:
             valid = valid & (kidx > qpos - window)
+        if quant:
+            # scale[r, c] = k_scale[c, grp[r % H]]: [Rp, KV] @ [BS, KV]^T
+            srow = jax.lax.dot_general(
+                oh_ref[...], ks_ref[0], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [Rp, BS]
+            s = s * srow
         s = jnp.where(valid, s, _NEG_INF)
         m = m_ref[...]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -118,6 +137,19 @@ def _paged_kernel(tab_ref, pos_ref, *refs, nb: int, bs: int, tq: int,
                                                   keepdims=True)
         m_ref[...] = m_new
         v = v_ref[0]
+        if quant:
+            # v's scale varies per (position, head): fold
+            # v_scale[c, grp[r % H]] into p before the PV dot — row r's
+            # output block then carries the dequantized sum, cross-head
+            # columns are garbage and discarded outside.  Mask invalid
+            # columns FIRST: positions past a slot's cursor carry a
+            # stale tenant's (or the zero-init null block's) scale rows
+            # — p is exactly 0 there, but 0 * garbage must stay 0.
+            vrow = jax.lax.dot_general(
+                oh_ref[...], vs_ref[0], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [Rp, BS]
+            p = p * jnp.where(valid, vrow, 0.0)
+            v = v.astype(cdt)
         # no tail handling: every physical block is exactly `bs` rows
         # (the pool's second dim), so chunks are never ragged
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
@@ -132,6 +164,7 @@ def _paged_kernel(tab_ref, pos_ref, *refs, nb: int, bs: int, tq: int,
 
 @functools.partial(jax.jit, static_argnames=("window", "interpret"))
 def paged_decode_attention(q, ck, cv, table, pos, *,
+                           k_scale=None, v_scale=None,
                            window: Optional[int] = None, interpret=None):
     """Fused cached attention straight out of a paged block pool.
 
@@ -153,6 +186,12 @@ def paged_decode_attention(q, ck, cv, table, pos, *,
     ``pl.when`` skips the arithmetic — the per-tick cache stream is
     each slot's ACTUAL prefix, not ``max_blocks * block`` rows of
     null-block padding.
+
+    Quantized pools (``kv_dtype="int8"``, PR 19): pass int8 ``ck/cv``
+    plus per-(position, head) scale pools ``k_scale/v_scale
+    [n_blocks, block, KV]`` and each grid step DMAs the s8 chunk + its
+    scale rows and dequantizes in-register before the accumulate —
+    the HBM stream stays at the pool's (halved) width.
     """
     B, tq, H, D = q.shape
     nb_phys, bs, KVD = ck.shape
@@ -167,6 +206,22 @@ def paged_decode_attention(q, ck, cv, table, pos, *,
     G = H // KV
     nb = table.shape[-1]
     interpret = resolve_interpret(interpret)
+
+    quant = k_scale is not None or v_scale is not None
+    if quant:
+        if k_scale is None or v_scale is None:
+            raise ValueError("quantized pool needs BOTH k_scale and "
+                             "v_scale (per-(position, head) rows)")
+        if ck.dtype != jnp.int8:
+            raise ValueError(f"scales passed but pool dtype is "
+                             f"{ck.dtype}, expected int8")
+        want = (nb_phys, bs, KV)
+        if tuple(k_scale.shape) != want or tuple(v_scale.shape) != want:
+            raise ValueError(
+                f"scale pool shape {k_scale.shape}/{v_scale.shape} != "
+                f"{want} ([n_blocks, block, kv_heads])")
+    elif ck.dtype == jnp.int8:
+        raise ValueError("int8 pool needs k_scale/v_scale")
 
     # Block-diagonal scaled query [B, tq*H (pad 16), KV*D]: row (i, h)
     # = q[i, h] * D^-1/2 in its group's D-block (ops/decode_attention.py
@@ -195,14 +250,31 @@ def paged_decode_attention(q, ck, cv, table, pos, *,
                 jj, jnp.maximum(pos_ref[b] - window + 1, 0) // bs)
         return (tab_ref[b, jj], 0, 0)
 
+    in_specs = [
+        pl.BlockSpec((1, Rp, KVD), lambda b, j, t, p: (b, 0, 0)),
+        pl.BlockSpec((1, bs, KVD), kv_idx),
+        pl.BlockSpec((1, bs, KVD), kv_idx),
+    ]
+    operands = [qblk, ck, cv]
+    if quant:
+        # scale rows ride the SAME indirected index map as their block;
+        # the onehot row->group matrix is tiled per query position
+        # (row r = (i, h) -> group of head r % H) and grid-constant.
+        oh_rows = jnp.tile(onehot, (tq, 1)).astype(jnp.float32)
+        if Rp != R:
+            oh_rows = jnp.pad(oh_rows, ((0, Rp - R), (0, 0)))
+        in_specs += [
+            pl.BlockSpec((1, bs, KV), kv_idx),
+            pl.BlockSpec((1, bs, KV), kv_idx),
+            pl.BlockSpec((Rp, KV), lambda b, j, t, p: (0, 0)),
+        ]
+        operands += [k_scale.astype(jnp.float32),
+                     v_scale.astype(jnp.float32), oh_rows]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, nb),
-        in_specs=[
-            pl.BlockSpec((1, Rp, KVD), lambda b, j, t, p: (b, 0, 0)),
-            pl.BlockSpec((1, bs, KVD), kv_idx),
-            pl.BlockSpec((1, bs, KVD), kv_idx),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, Rp, KVD),
                                lambda b, j, t, p: (b, 0, 0)),
         scratch_shapes=[
@@ -213,13 +285,13 @@ def paged_decode_attention(q, ck, cv, table, pos, *,
     )
     oacc = pl.pallas_call(
         functools.partial(_paged_kernel, nb=nb, bs=bs, tq=tq, H=H,
-                          window=window),
+                          window=window, quant=quant, cdt=q.dtype),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Rp, KVD), q.dtype),
         compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
-    )(tab_arr, pos_arr, qblk, ck, cv)
+    )(tab_arr, pos_arr, *operands)
 
     # Row (i, h)'s true output lives in its group's D-block; cross-head
     # columns of the PV dot are discarded by the static onehot

@@ -213,7 +213,7 @@ class BlockTable:
 
 
 def init_paged_cache(cfg: TransformerConfig, n_blocks: int, block: int,
-                     layout: str = "grouped"):
+                     layout: str = "grouped", kv_dtype: str = ""):
     """Zeroed paged KV cache: per layer ``{"k","v"}``.
 
     * ``"grouped"`` — ``[n_blocks, block, kv_heads, d_head]``: the
@@ -227,9 +227,29 @@ def init_paged_cache(cfg: TransformerConfig, n_blocks: int, block: int,
       lesson, ops/decode_attention.py), so the layout lives in the
       pool itself.
 
-    The int8 cache reads quantized values at traced positions (refused
-    upstream, ``ServingEngine``)."""
+    ``kv_dtype="int8"`` stores the pool quantized: s8 values in the
+    FLAT layout (regardless of ``layout`` — the scale row is
+    per-position, so the flat stream is the only layout whose block is
+    still one contiguous chunk) plus f32 per-(position, head) scales
+    ``{"k_scale","v_scale"} [n_blocks, block, kv_heads]``
+    (``models.transformer._quantize_kv``).  Half the value bytes per
+    block; the fused kernel dequantizes in VMEM at DMA time, the
+    gather fallback attends the s8 rows through the dense mixed-dot
+    path (``_cached_attention_q8``) — quantize-at-write on BOTH, so
+    the two paths read identical stored bytes.
+
+    (The legacy dense ``kv_quant`` knob is refused upstream for paged
+    engines — ``kv_dtype`` is the paged quantization path.)"""
     KV, D = cfg.kv_heads, cfg.d_head
+    if kv_dtype == "int8":
+        shape = (n_blocks, block, KV * D)
+        return tuple(
+            {"k": jnp.zeros(shape, jnp.int8),
+             "v": jnp.zeros(shape, jnp.int8),
+             "k_scale": jnp.zeros((n_blocks, block, KV), jnp.float32),
+             "v_scale": jnp.zeros((n_blocks, block, KV), jnp.float32)}
+            for _ in range(cfg.num_layers)
+        )
     shape = ((n_blocks, block, KV * D) if layout == "flat"
              else (n_blocks, block, KV, D))
     return tuple(
@@ -259,13 +279,20 @@ class PagedSlotPool(SlotPool):
     def __init__(self, cfg: TransformerConfig, n_slots: int, max_seq: int,
                  *, block: int = 16, n_blocks: Optional[int] = None,
                  kv_bytes: int = 0, kv_quant: bool = False,
-                 layout: str = "grouped"):
+                 kv_dtype: str = "", layout: str = "grouped"):
         if kv_quant:
             raise ValueError(
-                "paged KV cache requires a dense cache (kv_quant=False):"
-                " gathered rows are attended at traced positions, which"
-                " under int8 reads already-quantized K/V and breaks the"
-                " bit-exact parity contract")
+                "the legacy kv_quant knob quantizes the dense cache and"
+                " is incompatible with paging (gathered rows attended at"
+                " traced positions would break its bit-exact parity"
+                " contract); quantize a paged pool with kv_dtype='int8'"
+                " (BYTEPS_SERVE_KV_DTYPE), whose quantize-at-write"
+                " discipline IS consistent at traced positions")
+        if kv_dtype not in ("", "int8"):
+            raise ValueError(
+                f"kv_dtype supports '' (the model dtype) or 'int8' "
+                f"(s8 blocks + per-(position, head) f32 scales), got "
+                f"{kv_dtype!r}")
         if layout not in ("grouped", "auto", "flat"):
             raise ValueError(
                 f'paged KV cache supports layout="grouped" (gather '
@@ -280,12 +307,23 @@ class PagedSlotPool(SlotPool):
                 f"max_seq wide so the paged attention program is "
                 f"shape-identical to the dense engine's")
         self.block = block
+        self.kv_dtype = kv_dtype
         self.max_blocks = max_seq // block
         KV, D = cfg.kv_heads, cfg.d_head
-        itemsize = jnp.dtype(cfg.dtype).itemsize
         # bytes of ONE physical block across every layer's k+v arrays —
-        # the honest unit for budget math and prefix-store accounting
-        self.block_bytes = cfg.num_layers * 2 * block * KV * D * itemsize
+        # the honest unit for budget math and prefix-store accounting.
+        # int8 pools pay 1 byte per value plus the 4-byte f32 scale per
+        # (position, head): at D=64 that is (D + 4)/(4*D) ≈ 0.266x the
+        # f32 block, so a fixed BYTEPS_SERVE_KV_MB budget holds ~3.8x
+        # the blocks (~1.9x vs bf16) — the capacity lever the sizing
+        # math below inherits for free.
+        if kv_dtype == "int8":
+            self.block_bytes = cfg.num_layers * 2 * block * (KV * D
+                                                             + 4 * KV)
+        else:
+            itemsize = jnp.dtype(cfg.dtype).itemsize
+            self.block_bytes = cfg.num_layers * 2 * block * KV * D \
+                * itemsize
         if n_blocks is None:
             if kv_bytes > 0:
                 n_blocks = kv_bytes // self.block_bytes
@@ -317,7 +355,8 @@ class PagedSlotPool(SlotPool):
 
     def _init_caches(self):
         return init_paged_cache(self.cfg, self._n_blocks, self.block,
-                                layout=self.layout)
+                                layout=self.layout,
+                                kv_dtype=self.kv_dtype)
 
     # ------------------------------------------------------------ lifecycle
 

@@ -325,6 +325,7 @@ class ServingEngine:
                  block: int = 16,
                  kv_mb: int = 0,
                  kv_blocks: Optional[int] = None,
+                 kv_dtype: str = "",
                  paged_kernel: str = "auto",
                  spec_k: int = 0,
                  spec_ngram: int = 3,
@@ -354,6 +355,34 @@ class ServingEngine:
         # write the span, scatter the touched blocks back — covers all
         # prefill, and the traced-position constraints below apply.
         self.paged = bool(paged)
+        # int8 paged pool (kv_dtype="int8", BYTEPS_SERVE_KV_DTYPE):
+        # blocks store s8 values + per-(position, head) f32 scale rows,
+        # quantized AT WRITE time on every path (fused scatter, chunk
+        # prefill, gather fallback) — every read at a traced position
+        # sees the same quantized bytes, so preempt/resume re-prefill
+        # and the disagg fallback reproduce identical int8 blocks.
+        # This is exactly the discipline the legacy dense kv_quant knob
+        # LACKS (its static-pos=0 whole-prompt prefill attends
+        # pre-quantization values), hence the two are mutually
+        # exclusive rather than composable.
+        if kv_dtype not in ("", "int8"):
+            raise ValueError(
+                f"kv_dtype must be '' or 'int8', got {kv_dtype!r}")
+        if kv_dtype and kv_quant:
+            raise ValueError(
+                "kv_quant and kv_dtype are mutually exclusive: kv_quant "
+                "quantizes the DENSE cache (whole-prompt prefill "
+                "attends pre-quantization values — incompatible with "
+                "paging/chunking/resume), kv_dtype quantizes the PAGED "
+                "block pool with write-time determinism.  Pick one: "
+                "kv_quant=True for dense engines, kv_dtype='int8' for "
+                "paged engines.")
+        if kv_dtype and not self.paged:
+            raise ValueError(
+                "kv_dtype='int8' quantizes the paged block pool and "
+                "requires paged=True; dense engines quantize with "
+                "kv_quant=True instead")
+        self.kv_dtype = kv_dtype
         # fused paged-attention kernel (ops/paged_attention.py): decode
         # and spec-verify read allocated, position-covered blocks IN
         # PLACE through the block table instead of gathering a dense
@@ -406,7 +435,11 @@ class ServingEngine:
                 "position attends int8 K/V where whole-prompt prefill "
                 "attends the pre-quantization values, breaking the "
                 "bit-exact parity contract.  Run kv_quant engines with "
-                "chunk=0, prefix_cache=False, paged=False.")
+                "chunk=0, prefix_cache=False, paged=False — or, to "
+                "quantize a PAGED engine, use kv_dtype='int8' "
+                "(BYTEPS_SERVE_KV_DTYPE), whose quantize-at-write "
+                "discipline is consistent at traced positions and "
+                "composes with chunking, prefix reuse, and resume.")
         # same hazard class for flash prefill: whole-prompt prefill at
         # static pos=0 can take the Pallas flash kernel (attn_impl=
         # "flash" + the gcd bucket gate), while a chunk at a traced
@@ -435,6 +468,10 @@ class ServingEngine:
         # attends pre-quantization values where decode attended int8),
         # and a flash-eligible whole-prompt prefill differs from dense
         # decode in accumulation order — both are refused at submit.
+        # (kv_dtype="int8" is deliberately NOT resume-unsafe: the paged
+        # pool quantizes at write time on every path, so a resume's
+        # chunked re-prefill reproduces the original run's int8 blocks
+        # byte-for-byte — the determinism the dense knob lacks.)
         if kv_quant:
             self._resume_unsafe = (
                 "kv_quant: resume prefill attends pre-quantization K/V "
@@ -473,6 +510,23 @@ class ServingEngine:
                     f"cached attention — the two differ in "
                     f"accumulation order, so accepted tokens could "
                     f"silently diverge from the non-speculative stream")
+            if (kv_dtype and not self.paged_kernel
+                    and jax.default_backend() == "tpu"):
+                # the int8 pool forces flat storage, and on TPU the
+                # gather fallback's tq=1 tick takes the fused decode
+                # kernel while the tq>1 verify runs dense q8 attention
+                # — the same accumulation-order divergence the
+                # cache_layout refusal above guards.  The fused paged
+                # kernel serves BOTH widths identically, so spec +
+                # int8 is fine with paged_kernel on (and off-TPU both
+                # widths run dense q8).
+                raise ValueError(
+                    "speculative decoding on an int8 paged pool "
+                    "(kv_dtype='int8') requires the fused paged kernel "
+                    "on TPU (paged_kernel='on'/'auto'): the gather "
+                    "fallback decodes tq=1 through the fused dense "
+                    "kernel while the tq>1 verify runs dense q8 "
+                    "attention, which differ in accumulation order")
             k = 1
             while k * 2 <= spec_k:
                 k *= 2
@@ -487,7 +541,7 @@ class ServingEngine:
             self.pool = PagedSlotPool(
                 cfg, n_slots, self.max_seq, block=block,
                 n_blocks=kv_blocks, kv_bytes=kv_mb << 20,
-                kv_quant=kv_quant,
+                kv_quant=kv_quant, kv_dtype=kv_dtype,
                 layout=("flat" if self.paged_kernel else cache_layout))
         else:
             self.pool = SlotPool(cfg, n_slots, self.max_seq,

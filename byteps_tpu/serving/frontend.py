@@ -1152,6 +1152,7 @@ def serve_from_env(env=None) -> int:
         paged=cfg.serve_paged,
         block=cfg.serve_block,
         kv_mb=cfg.serve_kv_mb,
+        kv_dtype=cfg.serve_kv_dtype,
         paged_kernel=cfg.serve_paged_kernel,
         spec_k=(cfg.serve_spec_k if cfg.serve_spec else 0),
         spec_ngram=cfg.serve_spec_ngram)

@@ -317,41 +317,55 @@ class PrefixCache:
 
 
 class PagedPrefixCache(PrefixCache):
-    """Prefix store over a **paged** KV pool: entries hold physical
-    block ids instead of copied row buffers (serving/blocks.py).
+    """Prefix store over a **paged** KV pool: a radix-style chain of
+    per-block nodes holding physical block ids instead of copied row
+    buffers (serving/blocks.py).
 
     This is the unification the paged refactor buys (SGLang's
     RadixAttention observation): the prefix store was already
     block-aligned, so once the cache itself is block-granular a prefix
-    *is* a list of blocks —
+    *is* a list of blocks — and once the INDEX is block-granular too,
+    the stored unit is one node per rolling-hash boundary:
 
-      * **insert is refcount bumps**: the request's own prefix blocks
-        gain a store reference (``entry.buffer`` = block id tuple); no
-        device-side extract, no duplicate bytes;
-      * **a hit is sharing**: the admitted slot's table adopts the
-        entry's blocks (another refcount bump) — zero device-side K/V
-        copies for whole shared blocks, enforced by the engine's
-        compile counters (``prefix_copy``/``prefix_extract`` stay 0);
-      * **eviction respects live refs**: dropping an entry decrefs its
-        blocks, and the allocator frees a block only when no slot maps
-        it — an LRU eviction can never yank K/V out from under a
-        decoding request.
+      * **one node per block boundary**: inserting a ``k``-block prefix
+        creates (at most) ``k`` nodes, each owning exactly ONE store
+        reference on its own physical block and keyed by the rolling
+        digest of the chain root..self.  A later insert that extends an
+        indexed chain creates only the NEW tail nodes — so two requests
+        whose common prefix was never inserted as one entry still meet
+        at the same nodes and share the same physical blocks;
+      * **canonical blocks**: when an insert walks onto an
+        already-indexed boundary, the EXISTING node's physical id wins
+        and the newcomer's duplicate block simply keeps its slot
+        refcount (freed when the request's table releases it).  This
+        dedup is sound because block content is a deterministic
+        function of the token prefix — prefill is bit-reproducible,
+        and ``kv_dtype="int8"`` pools quantize at write time so even
+        the quantized bytes are identical (docs/serving.md);
+      * **a hit is sharing**: ``match`` (inherited — the node's key IS
+        the boundary key) returns the deepest verified node, whose
+        ``buffer`` is the full root..self id chain; the admitted slot's
+        table adopts those blocks (refcount bumps) — zero device-side
+        K/V copies, enforced by the engine's compile counters
+        (``prefix_copy``/``prefix_extract`` stay 0);
+      * **partial insert under budget**: the walk stores the longest
+        affordable prefix of new nodes instead of refusing the whole
+        chain — a long prompt's first blocks stay reusable even when
+        its tail does not fit ``max_bytes``;
+      * **leaf-only LRU eviction**: only nodes with no children and no
+        pins are victims, so the chain invariant (boundary ``j``
+        indexed ⟹ boundary ``j-1`` indexed) always holds and
+        ``insertable_len``'s last-boundary probe stays exact.  Evicting
+        a leaf decrefs ONE block; a cold chain drains tail-first.
+        Byte accounting is therefore exact — each node charges its one
+        block — where the old whole-entry store double-charged
+        overlapping chains.
 
-    Matching, hashing, verification, LRU, and the heir-repointing
-    eviction rule are inherited unchanged.  A store is bound to ONE
-    allocator (block ids are meaningless across pools), so paged
-    engines cannot share a store unless they share a pool —
-    ``ServingEngine`` refuses the cross-engine case loudly.
-
-    Budget accounting is **per reference, not per physical block**:
-    two entries whose block lists overlap each charge their full
-    length against ``max_bytes``, so the reported total can exceed the
-    physically pinned bytes and eviction errs toward keeping the store
-    *smaller* than the budget — conservative by construction, never
-    an overrun.  (Deduplicating the charge would require eviction to
-    know which surviving entries still cover each block; the simple
-    rule keeps release unconditional: every entry decrefs exactly the
-    ids it increfed.)
+    Matching, hashing, token verification, and LRU stamps are inherited
+    unchanged.  A store is bound to ONE allocator (block ids are
+    meaningless across pools), so paged engines cannot share a store
+    unless they share a pool — ``ServingEngine`` refuses the
+    cross-engine case loudly.
 
     Under block pressure the engine calls :meth:`evict_for` *before*
     preempting live requests: cached-but-unreferenced prefixes are the
@@ -365,6 +379,9 @@ class PagedPrefixCache(PrefixCache):
         self.block_bytes = block_bytes
         self._on_evict = on_evict
         self.blocks_released = 0
+        # radix bookkeeping, keyed by each node's boundary digest
+        self._node_parent: Dict[bytes, Optional[bytes]] = {}
+        self._node_children: Dict[bytes, int] = {}
 
     def insert(self, tokens, buffer, salt: bytes = b"",
                digests: Optional[List[bytes]] = None) -> bool:
@@ -374,10 +391,12 @@ class PagedPrefixCache(PrefixCache):
 
     def insert_blocks(self, tokens, block_ids, salt: bytes = b"",
                       digests: Optional[List[bytes]] = None) -> bool:
-        """Register ``tokens``' block-aligned prefix as shared blocks:
-        every id in ``block_ids`` gains a store reference.  Returns
-        False when nothing was stored (already indexed, or over the
-        whole byte budget); on False no references were taken."""
+        """Register ``tokens``' block-aligned prefix as a chain of
+        per-boundary nodes.  Boundaries already indexed are REUSED
+        (their canonical physical id wins — no new reference taken);
+        each new boundary becomes a node owning one store reference on
+        its block.  Returns False when nothing new was stored (fully
+        indexed already, or not a single new node fits the budget)."""
         toks = np.asarray(tokens, np.int32).reshape(-1).copy()
         length = int(toks.shape[0])
         nblocks = len(block_ids)
@@ -389,47 +408,138 @@ class PagedPrefixCache(PrefixCache):
             digs = digests[:nblocks]
         else:
             digs = self._digests(toks, nblocks, salt)
-        nbytes = nblocks * self.block_bytes
         with self._lock:
-            if digs[-1] in self._index:
-                return False  # already indexed
-            if self.max_bytes and nbytes > self.max_bytes:
-                return False  # a single entry cannot fit the budget
-            for bid in block_ids:
-                self.allocator.incref(bid)
-            entry = PrefixEntry(tuple(block_ids), toks, length, nbytes,
-                                next(self._clock), salt)
+            floor = next(self._clock)  # nodes created below are newer
+            chain: List[int] = []
+            created = False
+            parent: Optional[bytes] = None
             for j in range(1, nblocks + 1):
-                if digs[j - 1] not in self._index:
-                    self._index[digs[j - 1]] = (entry, j * self.block)
-                    entry.keys.append((digs[j - 1], j * self.block))
-            self._entries.append(entry)
-            self.insertions += 1
-            self._evict_to_budget_locked()
-            return True
+                blen = j * self.block
+                found = self._index.get(digs[j - 1])
+                if found is not None:
+                    node, node_blen = found
+                    if (node_blen != blen or not np.array_equal(
+                            node.tokens[:blen], toks[:blen])):
+                        # digest collision against a foreign chain:
+                        # stop extending rather than corrupt the walk
+                        break
+                    # canonical block: the indexed node's id wins
+                    chain.append(node.buffer[-1])
+                    node.stamp = next(self._clock)
+                    parent = digs[j - 1]
+                    continue
+                if self.max_bytes and (self.total_bytes
+                                       + self.block_bytes
+                                       > self.max_bytes):
+                    # partial insert: keep the affordable prefix, try
+                    # to fund the next node from LRU leaves older than
+                    # this call's own additions
+                    self._evict_to_budget_locked(
+                        headroom=self.block_bytes, stamp_before=floor)
+                    if self.total_bytes + self.block_bytes > \
+                            self.max_bytes:
+                        break
+                bid = block_ids[j - 1]
+                self.allocator.incref(bid)
+                chain.append(bid)
+                node = PrefixEntry(tuple(chain), toks[:blen], blen,
+                                   self.block_bytes, next(self._clock),
+                                   salt)
+                node.keys.append((digs[j - 1], blen))
+                self._index[digs[j - 1]] = (node, blen)
+                self._entries.append(node)
+                self._node_parent[digs[j - 1]] = parent
+                self._node_children[digs[j - 1]] = 0
+                if parent is not None:
+                    self._node_children[parent] += 1
+                parent = digs[j - 1]
+                created = True
+            if created:
+                self.insertions += 1
+            return created
+
+    # ------------------------------------------------- node-granular evict
+
+    def _evict_entry_locked(self, victim: PrefixEntry) -> None:
+        # leaf-only by construction (callers filter on children == 0):
+        # no heir scan is ever needed — a boundary digest names exactly
+        # one chain, and any other entry covering it would BE this node
+        digest, _ = victim.keys[0]
+        assert self._node_children.get(digest, 0) == 0, \
+            "evicting a prefix node that still has children"
+        self._entries.remove(victim)
+        self._index.pop(digest, None)
+        parent = self._node_parent.pop(digest, None)
+        self._node_children.pop(digest, None)
+        if parent is not None:
+            self._node_children[parent] -= 1
+        self.evictions += 1
+        self._release_entry(victim)
 
     def _release_entry(self, victim: PrefixEntry) -> None:
-        for bid in victim.buffer:
-            self.allocator.decref(bid)
-        self.blocks_released += len(victim.buffer)
+        # one node owns exactly one reference: its own (deepest) block
+        self.allocator.decref(victim.buffer[-1])
+        self.blocks_released += 1
         if self._on_evict is not None:
-            self._on_evict(len(victim.buffer))
+            self._on_evict(1)
+
+    def _leaves(self, stamp_before: Optional[int] = None
+                ) -> List[PrefixEntry]:
+        out = []
+        for e in self._entries:
+            if e.refs or self._node_children.get(e.keys[0][0], 0):
+                continue
+            if stamp_before is not None and e.stamp >= stamp_before:
+                continue
+            out.append(e)
+        return out
+
+    def _evict_to_budget_locked(self, headroom: int = 0,
+                                stamp_before: Optional[int] = None
+                                ) -> None:
+        if not self.max_bytes:
+            return
+        while self.total_bytes + headroom > self.max_bytes:
+            victims = self._leaves(stamp_before)
+            if not victims:
+                return  # everything pinned or interior; retry later
+            self._evict_entry_locked(min(victims, key=lambda e: e.stamp))
 
     def evict_for(self, n_blocks: int) -> bool:
-        """Block-pressure eviction: drop LRU unpinned entries until the
-        allocator has gained ``n_blocks`` free blocks or nothing
-        evictable remains.  Returns True when at least one entry was
+        """Block-pressure eviction: drop LRU unpinned leaf nodes until
+        the allocator has gained ``n_blocks`` free blocks or nothing
+        evictable remains.  Returns True when at least one node was
         dropped (the caller retries its allocation).  Note an evicted
-        entry frees only blocks no live slot shares — reclaiming less
-        than ``len(entry.buffer)`` is normal, not a bug."""
+        node frees its block only when no live slot shares it —
+        reclaiming nothing from a still-shared block is normal, not a
+        bug."""
         with self._lock:
             before = self.allocator.free_count
             progressed = False
             while self.allocator.free_count - before < n_blocks:
-                victims = [e for e in self._entries if e.refs == 0]
+                victims = self._leaves()
                 if not victims:
                     break
                 self._evict_entry_locked(min(victims,
                                              key=lambda e: e.stamp))
                 progressed = True
             return progressed
+
+    # ---------------------------------------------------------- inspection
+
+    @property
+    def entry_count(self) -> int:
+        """Distinct stored prefixes = chain leaves (nodes with no
+        children).  Interior nodes are shared structure, not separately
+        meaningful entries — a store holding one 4-block prefix counts
+        1, matching the old whole-entry semantics."""
+        with self._lock:
+            return sum(1 for e in self._entries
+                       if not self._node_children.get(e.keys[0][0], 0))
+
+    def stats(self) -> Dict[str, int]:
+        s = super().stats()
+        with self._lock:
+            s["entries"] = self.entry_count
+            s["nodes"] = len(self._entries)
+        return s

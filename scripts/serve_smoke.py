@@ -60,7 +60,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 def run(requests: int = 12, seed: int = 0, n_slots: int = 4,
         temperature: float = 0.0, verbose: bool = True,
         prefix_share: bool = False, paged: bool = False,
-        spec: int = 0, lockcheck: bool = False) -> dict:
+        kv_dtype: str = "", spec: int = 0,
+        lockcheck: bool = False) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -104,13 +105,32 @@ def run(requests: int = 12, seed: int = 0, n_slots: int = 4,
     sample_kw = ({} if temperature == 0
                  else {"top_k": 20})
     baselines = []
-    for job in jobs:
-        kw = dict(sample_kw)
-        if temperature != 0:
-            kw["rng"] = jax.random.PRNGKey(job["seed"])
-        out = generate(model, variables, job["prompt"][None],
-                       job["max_new"], temperature=temperature, **kw)
-        baselines.append(np.asarray(out["tokens"])[0])
+    if kv_dtype:
+        # int8 KV is lossy vs fp generate() (bounded, documented —
+        # docs/serving.md "int8 paged KV"), so the parity reference is
+        # an UNPRESSURED one-slot int8 engine run sequentially: the
+        # tight-pool threaded run below must reproduce it bit-for-bit
+        # across lazy grants, prefix eviction, and preempt/resume
+        ref = ServingEngine(
+            model, variables, n_slots=1, max_seq=cfg.max_seq_len,
+            temperature=temperature, paged=True, block=8,
+            kv_dtype=kv_dtype, metrics=ServeMetrics(), **sample_kw)
+        ref.start()
+        for job in jobs:
+            r = ref.submit(job["prompt"], job["max_new"],
+                           seed=job["seed"])
+            ref.drain(timeout=300)
+            baselines.append(np.asarray(r.result()))
+        ref.stop()
+    else:
+        for job in jobs:
+            kw = dict(sample_kw)
+            if temperature != 0:
+                kw["rng"] = jax.random.PRNGKey(job["seed"])
+            out = generate(model, variables, job["prompt"][None],
+                           job["max_new"], temperature=temperature,
+                           **kw)
+            baselines.append(np.asarray(out["tokens"])[0])
 
     engine_kw = dict(sample_kw)
     if spec:
@@ -125,6 +145,8 @@ def run(requests: int = 12, seed: int = 0, n_slots: int = 4,
         # eviction, AND preempt/resume — all of which must preserve
         # bit-exact parity per request
         engine_kw.update(paged=True, block=8, kv_blocks=16)
+        if kv_dtype:
+            engine_kw.update(kv_dtype=kv_dtype)
     off_out = None
     if prefix_share:
         engine_kw.update(chunk=8, prefix_cache=True, prefix_block=8)
@@ -234,6 +256,11 @@ def main(argv=None) -> int:
                          "pool: lazy grants, zero-copy prefix shares, "
                          "and preempt/resume under threaded arrivals "
                          "must all keep bit-exact parity")
+    ap.add_argument("--kv-int8", action="store_true",
+                    help="with --paged: int8 block pool (kv_dtype="
+                         "'int8') — parity vs an unpressured int8 "
+                         "engine (int8 is lossy vs fp generate(); "
+                         "int8-vs-int8 is bit-exact)")
     ap.add_argument("--spec", type=int, nargs="?", const=4, default=0,
                     help="n-gram speculative decoding at this depth "
                          "(default 4 when given bare): parity vs the "
@@ -245,11 +272,15 @@ def main(argv=None) -> int:
                          "cycle (BYTEPS_LOCKCHECK=1 equivalent; "
                          "docs/analysis.md)")
     args = ap.parse_args(argv)
+    if args.kv_int8 and not args.paged:
+        ap.error("--kv-int8 requires --paged (kv_dtype='int8' is a "
+                 "paged-pool knob)")
     ok = True
     for temp in (0.0, 0.8):
         stats = run(requests=args.requests, seed=args.seed,
                     n_slots=args.slots, temperature=temp,
                     prefix_share=args.prefix_share, paged=args.paged,
+                    kv_dtype="int8" if args.kv_int8 else "",
                     spec=args.spec, lockcheck=args.lockcheck)
         # paged engines compile one decode program per gather
         # high-water bucket (pos-capped gather); dense engines exactly

@@ -268,6 +268,80 @@ def test_adopt_blocks_is_ownership_transfer_with_typed_refusals(tiny):
     assert _pool_used(e) == used0  # ownership transfer, no leak
 
 
+# --------------------------------------------------- int8 pools on the wire
+
+
+def _int8_engine(tiny):
+    _, model, variables = tiny
+    return ServingEngine(model, variables, n_slots=4, max_seq=64,
+                         temperature=0.0, paged=True, block=8,
+                         chunk=16, kv_dtype="int8",
+                         metrics=ServeMetrics())
+
+
+def test_int8_geometry_contract_and_typed_dtype_refusal(tiny):
+    """The geometry string carries the pool's dtype AND the scale-row
+    leaves, so an int8 ship aimed at an fp32 pool (or vice versa) is
+    refused typed BEFORE any block is allocated — and the int8 wire
+    payload per block (s8 values + f32 scale rows, digest over those
+    exact bytes) is well under half the fp32 one."""
+    e8, e32 = _int8_engine(tiny), _paged_engine(tiny)
+    geom8, geom32 = pool_geometry(e8), pool_geometry(e32)
+    assert "int8" in geom8 and "k_scale" in geom8
+    assert "int8" not in geom32 and geom8 != geom32
+    st8, st32 = KVStager(e8), KVStager(e32)
+    # wire bytes per block == pool accounting bytes per block
+    assert st8._block_bytes == e8.pool.block_bytes
+    assert st32._block_bytes == e32.pool.block_bytes
+    assert st8._block_bytes < 0.35 * st32._block_bytes
+    raw = np.zeros(st8._block_bytes, np.uint8).tobytes()
+    used0 = _pool_used(e32)
+    with pytest.raises(KVShipGeometryError):
+        st32._accept(_meta("x8", 0, 2, geom8, _digest([raw])), raw)
+    assert st32.stats()["staged"] == 0 and _pool_used(e32) == used0
+    with pytest.raises(KVShipGeometryError):  # symmetric refusal
+        st8._accept(_meta("x32", 0, 2, geom32, _digest([raw])), raw)
+    assert st8.stats()["staged"] == 0
+
+
+def test_disagg_int8_ship_parity_and_shipped_bytes(tiny, prompts):
+    """End-to-end int8 disagg: shipped s8+scale blocks adopted by the
+    decode replica reproduce a single int8 engine's stream exactly
+    (write-time quantization makes the shipped bytes THE prefill), and
+    ``serve.kv_blocks_shipped_bytes`` reflects the shrunken blocks."""
+    ps = prompts[:2]
+    solo = _int8_engine(tiny)
+    reqs = [solo.submit(p, M) for p in ps]
+    solo.drain(timeout=120)
+    refs = [list(np.asarray(r.result())) for r in reqs]
+    engines = [_int8_engine(tiny) for _ in range(2)]
+    srvs = [serve(e, 0, host="127.0.0.1", in_thread=True)[0]
+            for e in engines]
+    addrs = ["127.0.0.1:%d" % s.server_address[1] for s in srvs]
+    router = ServeRouter(
+        addrs, roles=["prefill", "decode"], affinity=True, credits=4,
+        deadline=30.0, stream_timeout=5.0, registry=MetricsRegistry(),
+        retry=RetryPolicy(max_attempts=5, backoff_base=0.02,
+                          jitter=0.0, backoff_cap=0.1, deadline=0.0))
+    try:
+        for i, p in enumerate(ps):
+            got = list(router.stream(p, M, seed=100 + i))
+            assert got == refs[i], (i, got, refs[i])
+        st = router.stats()
+        assert st[rt.DISAGG_FALLBACKS] == 0
+        shipped = engines[0].metrics.get(sm.KV_BLOCKS_SHIPPED)
+        assert shipped >= 2 * len(ps)
+        # every shipped block moved exactly block_bytes — the halved
+        # int8 figure, not the fp32 one
+        assert engines[0].metrics.get(sm.KV_BLOCKS_SHIPPED_BYTES) == \
+            shipped * engines[0].pool.block_bytes
+    finally:
+        router.close()
+        for s in srvs:
+            s.shutdown()
+            s.server_close()
+
+
 # ------------------------------------------------- registered buffer pool
 
 

@@ -297,9 +297,11 @@ def test_pressure_evicts_prefix_store_before_preempting(tiny):
     eng.drain(timeout=120)
     assert len(big.result()) == 44
     assert eng.metrics.get(sm.BLOCK_EVICTIONS) >= 1
-    # the warm entry was pressure-evicted; the one remaining entry is
-    # big's OWN post-prefill insertion (refcount bumps on its blocks)
-    assert eng.prefix.evictions == 1 and eng.prefix.entry_count == 1
+    # the warm chain was pressure-evicted NODE BY NODE (the radix store
+    # drains a cold chain leaf-first: 2 blocks = 2 node evictions); the
+    # one remaining entry (= chain leaf) is big's OWN post-prefill
+    # insertion (refcount bumps on its blocks)
+    assert eng.prefix.evictions == 2 and eng.prefix.entry_count == 1
     assert eng.prefix.blocks_released == 2
 
 
