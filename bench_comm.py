@@ -30,7 +30,12 @@ single-chip bench.py cannot:
     — on-vs-off A/B of the local-mesh reduce-scatter stage: 4 emulated
     colocated workers against real shard processes on the 5 ms wire;
     measured mutation wire bytes/step must drop by ~local_size
-    (``--hierarchical`` runs just this).
+    (``--hierarchical`` runs just this);
+  * **ZeRO-1 optimizer-state sharding** (docs/parallel.md,
+    ``training/zero.py``) — replicated vs span-sharded eager PS
+    optimizer loop against real shard processes: per-rank mutation
+    wire bytes AND client optimizer-state bytes must drop by ~world,
+    final params bit-equal (``--zero`` runs just this).
 
 Prints ONE JSON line per point.  Runs anywhere (CPU virtual mesh by
 construction):  python bench_comm.py [--layers 8 --dim 1024]
@@ -657,6 +662,147 @@ def hierarchical_ab(workers=4, mb=2, delay_ms=5.0, steps=3, shards=2,
     return rows
 
 
+def zero_ab(world=2, mb=2, delay_ms=2.0, steps=5, shards=2, reps=3,
+            archive=True):
+    """ZeRO-1 optimizer-state sharding A/B over the PS tier
+    (docs/parallel.md, training/zero.py): ``world`` workers against
+    real PS shard processes behind an emulated ``delay_ms``/hop wire.
+
+      * REPLICATED: the pre-ZeRO eager loop — full client momentum,
+        one full parameter-delta mutation per worker per step;
+      * SHARDED: each worker keeps momentum for its owned spans only
+        and pushes just its ``name@z{r}`` span delta, then pulls the
+        peers' spans (pulls are reads — they never count as mutation
+        bytes, matching the hierarchical accounting above).
+
+    Both legs run the same ``sgd_momentum_update`` on the same
+    gradients, so the final parameters must match bitwise (reported as
+    ``bit_equal`` — a False here is a correctness bug, not noise).
+    Acceptance (ISSUE 20): per-rank mutation-byte AND client
+    optimizer-state reductions >= 0.9 x ``world`` (>= 1.8x at
+    world=2)."""
+    import dataclasses
+    import subprocess
+    import sys as _sys
+
+    from byteps_tpu.common.config import get_config, set_config
+    from byteps_tpu.compression import (get_compression_stats,
+                                        reset_compression_stats)
+    from byteps_tpu.engine import ps_server
+    from byteps_tpu.resilience import FaultInjectingProxy
+    from byteps_tpu.training.zero import (ReplicatedOptimizerState,
+                                          ShardedOptimizerState)
+
+    elems = mb * 1024 * 1024 // 4
+    rng = np.random.RandomState(0)
+    params0 = {"w": rng.randn(elems).astype(np.float32),
+               "b": rng.randn(257).astype(np.float32)}
+    grads = [{n: rng.randn(v.size).astype(np.float32)
+              for n, v in params0.items()} for _ in range(steps)]
+
+    ports = [_free_port() for _ in range(shards)]
+    procs, proxies, rows = [], [], []
+    saved_cfg = get_config()
+    try:
+        for p in ports:
+            procs.append(subprocess.Popen(
+                [_sys.executable, "-c",
+                 f"from byteps_tpu.engine import ps_server; "
+                 f"ps_server.serve({p}, host='127.0.0.1', "
+                 f"use_native=False)"],
+                env={**os.environ, "JAX_PLATFORMS": "cpu"}))
+        for p in ports:
+            _wait_port(p)
+        set_config(dataclasses.replace(saved_cfg, hierarchical=False))
+        proxies = [FaultInjectingProxy(f"127.0.0.1:{p}", seed=i)
+                   for i, p in enumerate(ports)]
+        for px in proxies:
+            px.set_rates(delay=delay_ms / 1e3)
+        addrs = [px.addr for px in proxies]
+
+        def leg_replicated(store, rep):
+            base = ReplicatedOptimizerState(
+                store, {f"r{rep}_{n}": v.copy()
+                        for n, v in params0.items()},
+                lr=0.05, momentum=0.9)
+            b0 = stats.summary()["wire_bytes_sent"]
+            t0 = time.perf_counter()
+            for g in grads:
+                base.step({f"r{rep}_{n}": v for n, v in g.items()})
+            dt = (time.perf_counter() - t0) / steps
+            bytes_rank = stats.summary()["wire_bytes_sent"] - b0
+            return bytes_rank, dt, base.state_bytes(), base
+
+        def leg_sharded(store, rep):
+            zs = [ShardedOptimizerState(
+                store, {f"z{rep}_{n}": v.copy()
+                        for n, v in params0.items()},
+                world=world, rank=r, lr=0.05, momentum=0.9)
+                for r in range(world)]
+            b0 = stats.summary()["wire_bytes_sent"]
+            t0 = time.perf_counter()
+            for g in grads:
+                gr = {f"z{rep}_{n}": v for n, v in g.items()}
+                for z in zs:   # split-phase: all pushes land first,
+                    z.push_updates(gr)
+                for z in zs:   # then every rank pulls peers' spans
+                    z.pull_params()
+            dt = (time.perf_counter() - t0) / steps
+            bytes_rank = (stats.summary()["wire_bytes_sent"] - b0) / world
+            return bytes_rank, dt, zs[0].state_bytes(), zs
+
+        reset_compression_stats()
+        stats = get_compression_stats()
+        store = ps_server.RemoteStore(addrs, transport="tcp")
+        rep_b = shd_b = rep_state = shd_state = 0
+        rep_t, shd_t, bit_equal = [], [], True
+        for rep in range(reps):  # interleaved: ambient load hits both
+            rep_b, t, rep_state, base = leg_replicated(store, rep)
+            rep_t.append(t)
+            shd_b, t, shd_state, zs = leg_sharded(store, rep)
+            shd_t.append(t)
+            bit_equal = bit_equal and all(
+                base.params[f"r{rep}_{n}"].tobytes()
+                == z.params[f"z{rep}_{n}"].tobytes()
+                for n in params0 for z in zs)
+        store.close()
+
+        row = {
+            "metric": "zero_mutation_bytes_per_rank_step",
+            "value": round(shd_b / steps / 1e6, 3),
+            "unit": "MB/rank/step (mutation payloads, ZeRO on)",
+            "replicated_mb_per_step": round(rep_b / steps / 1e6, 3),
+            "byte_reduction_x": round(rep_b / shd_b, 3),
+            "state_bytes_reduction_x": round(rep_state / shd_state, 3),
+            "bit_equal": bool(bit_equal),
+            "world": world,
+            "ms_per_step_sharded": round(min(shd_t) * 1e3, 2),
+            "ms_per_step_replicated": round(min(rep_t) * 1e3, 2),
+            "tensor_mb": mb,
+            "shards": shards,
+            "wire": f"emulated {delay_ms:g}ms/hop (proxy)",
+            "window": get_config().wire_window,
+            "tool": "bench_comm.py",
+        }
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    finally:
+        set_config(saved_cfg)
+        for px in proxies:
+            px.close()
+        for pr in procs:
+            pr.terminate()
+        for pr in procs:
+            try:
+                pr.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                pr.kill()
+                pr.wait(timeout=5)
+    if archive and rows:
+        _archive_rows(rows)
+    return rows
+
+
 def registered_recv_ab(kb=64, reps=2000, archive=True):
     """Registered-buffer receive A/B (the carried-over ps-lite-van
     gap): ps-lite's RDMA van registers each receive buffer once and
@@ -758,6 +904,12 @@ def main():
     ap.add_argument("--hier-workers", type=int, default=4,
                     help="emulated colocated worker count (= local_size)")
     ap.add_argument("--hier-mb", type=int, default=2)
+    ap.add_argument("--zero", action="store_true",
+                    help="run only the ZeRO-1 optimizer-state sharding "
+                         "A/B (docs/parallel.md, training/zero.py)")
+    ap.add_argument("--zero-world", type=int, default=2,
+                    help="ownership-group size for the --zero leg")
+    ap.add_argument("--zero-mb", type=int, default=2)
     # 1 MiB frames: the partition-sized regime the colocated client
     # actually sends, where per-frame transport cost dominates; 24
     # interleaved reps so min-of-reps escapes this host's throttle
@@ -778,6 +930,10 @@ def main():
                         delay_ms=args.wire_delay_ms,
                         archive=not args.no_archive)
         return
+    if args.zero:
+        zero_ab(world=args.zero_world, mb=args.zero_mb,
+                archive=not args.no_archive)
+        return
     pipelined_wire(mb=args.wire_mb, part_kb=args.wire_part_kb,
                    delay_ms=args.wire_delay_ms, reps=args.wire_reps,
                    archive=not args.no_archive)
@@ -786,6 +942,8 @@ def main():
     hierarchical_ab(workers=args.hier_workers, mb=args.hier_mb,
                     delay_ms=args.wire_delay_ms,
                     archive=not args.no_archive)
+    zero_ab(world=args.zero_world, mb=args.zero_mb,
+            archive=not args.no_archive)
     if args.wire_only:
         return
 

@@ -419,6 +419,142 @@ def paged_ab(long_reqs: int = 2, long_len: int = 160,
     return row
 
 
+def tp_ab(long_reqs: int = 2, long_len: int = 160,
+          short_reqs: int = 14, short_len: int = 16,
+          tokens: int = 48, slots: int = 16, base_slots: int = 1,
+          d_model: int = 256, layers: int = 2, vocab: int = 256,
+          block: int = 16, chunk: int = 32, max_seq: int = 256,
+          tp: int = 2, out_path: str = "BENCH_SERVE.json",
+          archive: bool = True):
+    """Tensor-parallel paged serving A/B (docs/parallel.md): the same
+    mixed long/short workload on a ``tp=1`` vs a ``tp``-sharded paged
+    engine.
+
+    Two claims, measured separately:
+
+      * **parity** — head-slicing the KV pool and attention is
+        arithmetic-identical by construction (softmax and the PV
+        matmul never cross head boundaries), so every emitted token
+        must match bit-for-bit;
+      * **capacity at fixed per-shard KV bytes** — a tp shard holds
+        ``1/tp`` of each block's bytes, so at the SAME per-shard
+        (= per-device) byte budget the sharded engine affords
+        ``tp x`` the blocks and SUSTAINS proportionally more
+        concurrent decodes.  Sustained = mean sampled in-flight count:
+        slot assignment is not block-gated (fresh admissions land and
+        the newest gets preempted under pressure), so the *peak* slot
+        occupancy transiently hits the slot count in both legs — the
+        block budget bounds how many requests stay resident, which is
+        what the mean sees.
+
+    Both engines are paged with ``slots`` slots; decode length is
+    sized so steady-state residency, not admission, dominates."""
+    cfg = TransformerConfig(
+        vocab_size=vocab, num_layers=layers, num_heads=4,
+        d_model=d_model, d_ff=4 * d_model, max_seq_len=max_seq,
+        dtype=jnp.float32)
+    model = Transformer(cfg)
+    variables = model.init(jax.random.PRNGKey(1),
+                           jnp.zeros((1, 8), jnp.int32))
+    longs = _prompts(long_reqs, long_len, vocab)
+    shorts = _prompts(short_reqs + 2, short_len, vocab)
+    mixed = shorts[:short_reqs // 2] + longs + shorts[short_reqs // 2:
+                                                     short_reqs]
+
+    def run_engine(prompts, eng_tp, kv_blocks):
+        eng = ServingEngine(
+            model, variables, n_slots=slots, max_seq=max_seq,
+            temperature=0.0, max_queue=4 * len(prompts), chunk=chunk,
+            # generous admission per tick: peak concurrency must be
+            # bounded by the BLOCK budget under test, not by the
+            # prefill-credit throttle
+            prefill_credits=8 * max_seq,
+            paged=True, block=block, kv_blocks=kv_blocks, tp=eng_tp,
+            metrics=ServeMetrics())
+        eng.start()
+        eng.submit(shorts[-1], tokens)  # warmup: compile off-timer
+        eng.drain(timeout=600)
+        eng.submit(longs[0], tokens)    # (long bucket chain too)
+        eng.drain(timeout=600)
+        eng.metrics = ServeMetrics()
+        samples = []
+        stop = threading.Event()
+
+        def sample():
+            while not stop.is_set():
+                samples.append(eng.pool.active_count)
+                time.sleep(0.002)
+
+        t = threading.Thread(target=sample, daemon=True)
+        t.start()
+        t0 = time.perf_counter()
+        reqs = [eng.submit(p, tokens) for p in prompts]
+        eng.drain(timeout=600)
+        elapsed = time.perf_counter() - t0
+        stop.set()
+        t.join()
+        outs = [np.asarray(r.result()) for r in reqs]
+        summ = eng.metrics.summary()
+        eng.stop()
+        return {"elapsed_s": round(elapsed, 4),
+                "peak_concurrent": max(samples, default=0),
+                "mean_concurrent": round(
+                    sum(samples) / max(len(samples), 1), 2),
+                "ttft_p50_ms": round(summ["ttft_p50_s"] * 1e3, 2),
+                "tpot_p50_ms": round(summ["tpot_p50_s"] * 1e3, 2),
+                "outs": outs}
+
+    # leg 1 — parity at a roomy identical budget (no preemption noise)
+    roomy = slots * (max_seq // block) + 1
+    uni_1 = run_engine(mixed, 1, roomy)
+    uni_tp = run_engine(mixed, tp, roomy)
+    mismatches = sum(
+        0 if np.array_equal(a, b) else 1
+        for a, b in zip(uni_1["outs"], uni_tp["outs"]))
+    # leg 2 — fixed per-shard bytes: the tp pool's blocks are 1/tp the
+    # bytes per shard, so the same per-shard budget buys tp x blocks
+    base_blocks = base_slots * (max_seq // block) + 1
+    cap_1 = run_engine(mixed, 1, base_blocks)
+    cap_tp = run_engine(mixed, tp, tp * base_blocks)
+    mismatches += sum(
+        0 if np.array_equal(a, b) else 1
+        for a, b in zip(cap_1["outs"], cap_tp["outs"]))
+    row = {
+        "metric": "serve_tp_paged",
+        "backend": jax.default_backend(),
+        "model": {"d_model": d_model, "layers": layers, "vocab": vocab,
+                  "max_seq": max_seq, "block": block, "chunk": chunk},
+        "tp": tp,
+        "requests": len(mixed), "long_reqs": long_reqs,
+        "long_len": long_len, "short_len": short_len,
+        "tokens_per_request": tokens,
+        "per_shard_budget_blocks": base_blocks,
+        "tp1_blocks": base_blocks, "tp_blocks": tp * base_blocks,
+        "tp1_peak_concurrent": cap_1["peak_concurrent"],
+        "tp_peak_concurrent": cap_tp["peak_concurrent"],
+        "tp1_mean_concurrent": cap_1["mean_concurrent"],
+        "tp_mean_concurrent": cap_tp["mean_concurrent"],
+        "concurrency_ratio": round(
+            cap_tp["mean_concurrent"]
+            / max(cap_1["mean_concurrent"], 0.01), 2),
+        "tp1_elapsed_s": uni_1["elapsed_s"],
+        "tp_elapsed_s": uni_tp["elapsed_s"],
+        "tp1_ttft_p50_ms": uni_1["ttft_p50_ms"],
+        "tp_ttft_p50_ms": uni_tp["ttft_p50_ms"],
+        "tp1_tpot_p50_ms": uni_1["tpot_p50_ms"],
+        "tp_tpot_p50_ms": uni_tp["tpot_p50_ms"],
+        "mismatches": mismatches,
+    }
+    print(json.dumps(row))
+    if mismatches:
+        raise RuntimeError(
+            f"tp={tp} engine broke token parity: {mismatches} "
+            f"mismatched requests")
+    if archive:
+        _archive_rows([row], out_path)
+    return row
+
+
 def paged_kernel_ab(requests: int = 12, tokens: int = 16,
                     prompt_lens=(12, 40, 88), slots: int = 6,
                     d_model: int = 256, layers: int = 2,
@@ -1572,6 +1708,11 @@ def main(argv=None) -> int:
                          "fixed KV byte budget (peak concurrency "
                          "ratio, uniform-leg TPOT overhead, run-to-"
                          "run reproducibility)")
+    ap.add_argument("--tp", action="store_true",
+                    help="run only the tensor-parallel paged serving "
+                         "A/B (tp=1 vs tp=2: bit parity + peak "
+                         "concurrency at fixed per-shard KV bytes; "
+                         "docs/parallel.md)")
     ap.add_argument("--shared-len", type=int, default=96)
     ap.add_argument("--requests", type=int, default=12)
     ap.add_argument("--chunk", type=int, default=32)
@@ -1604,6 +1745,17 @@ def main(argv=None) -> int:
                          "spec-on vs spec-off interleaved reps, parity "
                          "asserted)")
     args = ap.parse_args(argv)
+    if args.tp:
+        row = tp_ab(chunk=args.chunk, out_path=args.out,
+                    archive=not args.no_archive)
+        ok = (row["mismatches"] == 0 and row["concurrency_ratio"] >= 1.3)
+        print(f"tp serving: parity {row['mismatches']} mismatches, "
+              f"sustained concurrency {row['tp1_mean_concurrent']} "
+              f"(tp=1) -> {row['tp_mean_concurrent']} "
+              f"(tp={row['tp']}) at fixed per-shard KV bytes "
+              f"({'PASS' if ok else 'FAIL'} bit parity + >=1.3x "
+              f"sustained concurrency)")
+        return 0 if ok else 1
     if args.autoscale:
         row = autoscale_spike(out_path=args.out,
                               archive=not args.no_archive)

@@ -1,0 +1,140 @@
+"""Partition-spec axis-name discipline (``pspec-unknown-axis``).
+
+The mesh axis vocabulary is fixed in ``parallel/mesh.py``'s
+``AXIS_ORDER`` — ``build_mesh`` refuses any other name, and every
+collective/sharding helper keys off those strings.  But a
+``PartitionSpec`` is built far from the mesh, and jax only validates
+its axis names at ``device_put``/``jit`` time *against the mesh in
+scope*: a spec written with a name outside the roster (``"model"``,
+``"data"``, a typo like ``"tpp"``) type-checks, imports, and then
+either throws deep inside XLA or — worse, with ``Mesh``-less tracing —
+silently replicates the tensor it was supposed to shard.
+
+This pass closes the gap statically: every **string literal** appearing
+as an axis name in a ``PartitionSpec(...)`` call (under any import
+alias, e.g. ``P``) must be a member of the roster.  Names that arrive
+through variables are out of static reach and are validated at runtime
+by ``build_mesh``/``parse_mesh_shape`` instead; the literal case is
+exactly the one a reviewer's eye skips.
+
+The roster itself is read from ``parallel/mesh.py`` by AST (no jax
+import — the lint must stay runnable on jax-less hosts), so adding an
+axis there automatically widens this pass.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set, Tuple
+
+from .violations import Violation
+
+__all__ = ["analyze_pspec_source", "mesh_axis_roster"]
+
+_PSPEC_QUALNAME = "PartitionSpec"
+_MESH_MODULE = "byteps_tpu/parallel/mesh.py"
+_ROSTER_NAME = "AXIS_ORDER"
+
+
+def mesh_axis_roster(mesh_src: str) -> Set[str]:
+    """Extract ``AXIS_ORDER`` from ``parallel/mesh.py`` source by AST.
+    Raises if the assignment vanished or stopped being a literal —
+    a silent empty roster would flag every spec in the tree."""
+    tree = ast.parse(mesh_src)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == _ROSTER_NAME:
+                    value = ast.literal_eval(node.value)
+                    roster = {str(a) for a in value}
+                    if not roster:
+                        raise ValueError(f"{_ROSTER_NAME} is empty")
+                    return roster
+    raise ValueError(
+        f"could not find a literal {_ROSTER_NAME} assignment in "
+        f"{_MESH_MODULE}")
+
+
+def _pspec_aliases(tree: ast.AST) -> Set[str]:
+    """Local names bound to ``jax.sharding.PartitionSpec`` anywhere in
+    the module (module- or function-level ``from jax.sharding import
+    PartitionSpec [as P]``)."""
+    aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module and \
+                "sharding" in node.module:
+            for a in node.names:
+                if a.name == _PSPEC_QUALNAME:
+                    aliases.add(a.asname or a.name)
+    return aliases
+
+
+def _literal_axes(node: ast.AST) -> Iterator[Tuple[str, int]]:
+    """Yield ``(axis_literal, line)`` for every string constant inside
+    one PartitionSpec argument — a bare string, or strings nested in a
+    tuple/list (``P(("dp", "tp"))`` shards one dim over two axes)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        yield node.value, node.lineno
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            yield from _literal_axes(elt)
+
+
+def _enclosing_symbols(tree: ast.AST) -> List[Tuple[int, int, str]]:
+    """``(start, end, "Class.method")`` spans for symbol attribution."""
+    spans: List[Tuple[int, int, str]] = []
+
+    def visit(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                name = f"{prefix}.{child.name}" if prefix else child.name
+                spans.append((child.lineno,
+                              child.end_lineno or child.lineno, name))
+                visit(child, name)
+            else:
+                visit(child, prefix)
+
+    visit(tree, "")
+    return spans
+
+
+def analyze_pspec_source(src: str, path: str,
+                         roster: Set[str]) -> List[Violation]:
+    """Flag unknown axis-name literals in PartitionSpec calls in one
+    module (``pspec-unknown-axis``)."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:  # pragma: no cover
+        return []
+    aliases = _pspec_aliases(tree)
+    if not aliases:
+        return []
+    spans = _enclosing_symbols(tree)
+
+    def symbol(line: int) -> str:
+        best: Optional[Tuple[int, str]] = None
+        for a, b, name in spans:
+            if a <= line <= b and (best is None or a > best[0]):
+                best = (a, name)
+        return best[1] if best else "<module>"
+
+    out: List[Violation] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in aliases):
+            continue
+        args = list(node.args) + [kw.value for kw in node.keywords]
+        for arg in args:
+            for axis, line in _literal_axes(arg):
+                if axis not in roster:
+                    out.append(Violation(
+                        "pspec-unknown-axis", path, symbol(line), axis,
+                        f"PartitionSpec axis {axis!r} is not in "
+                        f"parallel/mesh.py AXIS_ORDER "
+                        f"({', '.join(sorted(roster))}) — build_mesh "
+                        f"can never construct a mesh with it, so this "
+                        f"spec either crashes at device_put or "
+                        f"silently replicates", line))
+    return out

@@ -10,7 +10,7 @@ from __future__ import annotations
 import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from . import envknobs, locks, metricnames, protocols
+from . import envknobs, locks, metricnames, partitionspecs, protocols
 from .violations import (Baseline, Violation, apply_baseline,
                          load_baseline)
 
@@ -24,6 +24,7 @@ ALL_RULES = (
     "metric-type-conflict", "metric-undocumented",
     "proto-op-collision", "proto-missing-dispatch",
     "proto-missing-producer", "proto-undocumented-op",
+    "pspec-unknown-axis",
 )
 
 BASELINE_FILE = ".analysis-baseline.json"
@@ -75,10 +76,13 @@ def run_all(root: Optional[str] = None,
         with open(os.path.join(root, rel), encoding="utf-8") as f:
             return f.read()
 
+    roster = partitionspecs.mesh_axis_roster(
+        read("byteps_tpu/parallel/mesh.py"))
     found: List[Violation] = []
     for path, src in sources:
         found.extend(locks.analyze_locks_source(src, path))
         found.extend(envknobs.analyze_env_source(src, path))
+        found.extend(partitionspecs.analyze_pspec_source(src, path, roster))
     found.extend(envknobs.check_env_docs(
         read("byteps_tpu/common/config.py"), read("docs/env.md")))
     found.extend(metricnames.check_metric_names(

@@ -283,6 +283,18 @@ class Config:
     # back on demand); off = PR 14 strict reservation exactly
     slo_borrow: bool = True
 
+    # --- DP x MP meshes end to end (docs/parallel.md) --------------------
+    # tensor-parallel shard count of the serving engine's paged KV pool
+    # (serving/blocks.py [tp, n_blocks, block, (KV/tp)*D] layout); 1 =
+    # unsharded.  Engines built with tp=0 defer to this knob.
+    serve_tp: int = 1
+    # ZeRO-1 optimizer-state sharding over the PS tier
+    # (training/zero.py): workers keep momentum/EF state only for their
+    # owned parameter spans and push span-keyed deltas
+    zero: bool = False
+    # ownership group size for ZeRO spans; 0 = DMLC_NUM_WORKER
+    zero_world: int = 0
+
     # --- pipelined wire engine (byteps_tpu/engine/wire.py; the client
     # half of the push/pull pipelining BytePS keeps the wire busy with —
     # docs/wire.md) -------------------------------------------------------
@@ -453,6 +465,9 @@ class Config:
             slo_service_estimate_ms=_env_float(
                 "BYTEPS_SLO_SERVICE_ESTIMATE_MS", 500.0),
             slo_borrow=_env_bool("BYTEPS_SLO_BORROW", True),
+            serve_tp=_env_int("BYTEPS_TP", 1),
+            zero=_env_bool("BYTEPS_ZERO"),
+            zero_world=_env_int("BYTEPS_ZERO_WORLD", 0),
             wire_window=_env_int("BYTEPS_WIRE_WINDOW", 8),
             wire_fanout=_env_int("BYTEPS_WIRE_FANOUT", 16),
             transport=_env_str("BYTEPS_TRANSPORT", "auto"),

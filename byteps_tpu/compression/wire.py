@@ -233,3 +233,16 @@ class WireCompressor:
         with self._lock:
             r = self._residual.get(name)
         return 0.0 if r is None else float(np.linalg.norm(r))
+
+    def residual_bytes(self, prefix: str = "") -> int:
+        """Client-side error-feedback residual footprint in bytes,
+        optionally restricted to wire names starting with ``prefix``.
+
+        Residuals are keyed per wire name, so a ZeRO client
+        (training/zero.py) — which only ever pushes its OWNED span keys
+        — holds ~1/world of the replicated client's residual state: the
+        EF memory shards for free alongside the optimizer state.  This
+        hook is the accounting surface the bench/tests pin that on."""
+        with self._lock:
+            return sum(int(r.nbytes) for n, r in self._residual.items()
+                       if n.startswith(prefix))

@@ -78,9 +78,11 @@ def slice_name(name: str, rank: int) -> str:
 
 
 def is_sliced_name(name: str) -> bool:
-    """True for names that already carry slice (or partition) markers —
-    they must never be re-sliced."""
-    return SLICE_SEP in name or "#p" in name
+    """True for names that already carry slice, partition, or ZeRO-span
+    markers — they must never be re-sliced (a ZeRO ``name@z{r}`` span
+    key, training/zero.py, is already the 1/world unit the hierarchical
+    layer would otherwise try to manufacture)."""
+    return SLICE_SEP in name or "#p" in name or "@z" in name
 
 
 def parse_slice_rank(name: str, base: str) -> Optional[int]:

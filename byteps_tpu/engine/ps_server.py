@@ -1606,6 +1606,57 @@ class RemoteStore:
         with self._traced("pull", name):
             return self._pull_traced(name)
 
+    def pull_many(self, names) -> dict:
+        """Pull several tensors through ONE windowed fan-out pass:
+        ``{name: array}``.  The ZeRO pull-params phase
+        (training/zero.py) pulls ``world - 1`` span keys per step; a
+        serial loop pays one wire round trip each, while this rides
+        every part of every name down the same pipelined window the
+        partition fan-out uses (docs/wire.md).  Names this client holds
+        no meta for (sliced elsewhere, never touched) fall back to the
+        discovery path of :meth:`pull` individually."""
+        names = list(names)
+        parts, counts, fast = [], [], []
+        for name in names:
+            if self._hier_meta_of(name) is not None:
+                fast.append(False)
+                continue
+            meta = self._part_names(name)
+            with self._state_lock:
+                known = name in self._part_meta
+            if meta is None and not known:
+                fast.append(False)  # never seen: needs discovery
+                continue
+            fast.append(True)
+            if meta is None:
+                parts.append((name, None))
+                counts.append((1, None, None))
+            else:
+                nparts, shape, dtype = meta
+                parts.extend((f"{name}#p{i}", None) for i in range(nparts))
+                counts.append((nparts, shape, dtype))
+        with self._traced("pull", f"pull_many[{len(names)}]"):
+            outs = (self._pipeline_parts(OP_PULL, parts, self._encode_raw,
+                                         0)
+                    if parts else [])
+        result, off, ci = {}, 0, 0
+        for name, is_fast in zip(names, fast):
+            if not is_fast:
+                result[name] = self.pull(name)
+                continue
+            k, shape, dtype = counts[ci]
+            ci += 1
+            if k == 1 and shape is None:
+                result[name] = np.array(outs[off])
+            else:
+                chunks = [np.asarray(o).reshape(-1)
+                          for o in outs[off:off + k]]
+                flat = self._assemble_flat(chunks, dtype or chunks[0].dtype)
+                result[name] = (flat if shape is None
+                                else flat.reshape(shape))
+            off += k
+        return result
+
     def _pull_traced(self, name: str) -> np.ndarray:
         prio = self._priority_of(name)
         hm = self._hier_meta_of(name)

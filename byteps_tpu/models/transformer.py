@@ -512,7 +512,29 @@ class Attention(nn.Module):
                 B_, T_ = x.shape[0], x.shape[1]
                 pk, pv = cache["k"], cache["v"]
                 wblk, woff = cache["wblk"], cache["woff"]
-                from ..ops.paged_attention import paged_decode_attention
+                from ..ops.paged_attention import (
+                    paged_decode_attention, paged_decode_attention_sharded)
+
+                # tensor-parallel pool: a leading tp axis of per-shard
+                # flat pools [tp, n_blocks, block, (KV/tp)*D]
+                # (init_paged_cache tp>1).  ndim is unambiguous here —
+                # only FLAT pools reach the fused branch, so 4-D means
+                # sharded, never grouped.  The flat minor axis is
+                # head-major, so reshape(B, T, tp, X/tp) splits fresh
+                # rows into exactly each shard's KV-head slice; the
+                # table/write targets are head-agnostic and shared.
+                tp_ = pk.shape[0] if pk.ndim == 4 else 1
+                dst = ((wblk, woff) if tp_ == 1
+                       else (slice(None), wblk, woff))
+                attend = (paged_decode_attention if tp_ == 1
+                          else paged_decode_attention_sharded)
+
+                def _shard_rows(rows):
+                    if tp_ == 1:
+                        return rows
+                    w = rows.shape[-1]
+                    return rows.reshape(
+                        B_, T_, tp_, w // tp_).transpose(2, 0, 1, 3)
 
                 if pk.dtype == jnp.int8:
                     # int8 pool (kv_dtype="int8"): quantize-at-scatter —
@@ -525,11 +547,15 @@ class Attention(nn.Module):
                     kq, ks = _quantize_kv(k)
                     vq, vs = _quantize_kv(v)
                     pks, pvs = cache["k_scale"], cache["v_scale"]
-                    pk = pk.at[wblk, woff].set(kq.reshape(B_, T_, KV * D))
-                    pv = pv.at[wblk, woff].set(vq.reshape(B_, T_, KV * D))
-                    pks = pks.at[wblk, woff].set(ks.astype(pks.dtype))
-                    pvs = pvs.at[wblk, woff].set(vs.astype(pvs.dtype))
-                    out = paged_decode_attention(
+                    pk = pk.at[dst].set(
+                        _shard_rows(kq.reshape(B_, T_, KV * D)))
+                    pv = pv.at[dst].set(
+                        _shard_rows(vq.reshape(B_, T_, KV * D)))
+                    pks = pks.at[dst].set(
+                        _shard_rows(ks.astype(pks.dtype)))
+                    pvs = pvs.at[dst].set(
+                        _shard_rows(vs.astype(pvs.dtype)))
+                    out = attend(
                         q, pk, pv, cache["table"], pos,
                         k_scale=pks, v_scale=pvs,
                         window=cfg.attn_window)
@@ -537,11 +563,10 @@ class Attention(nn.Module):
                                              k_scale=pks, v_scale=pvs)
                 row_k = k.reshape(B_, T_, KV * D).astype(pk.dtype)
                 row_v = v.reshape(B_, T_, KV * D).astype(pv.dtype)
-                pk = pk.at[wblk, woff].set(row_k)
-                pv = pv.at[wblk, woff].set(row_v)
-                out = paged_decode_attention(q, pk, pv, cache["table"],
-                                             pos,
-                                             window=cfg.attn_window)
+                pk = pk.at[dst].set(_shard_rows(row_k))
+                pv = pv.at[dst].set(_shard_rows(row_v))
+                out = attend(q, pk, pv, cache["table"], pos,
+                             window=cfg.attn_window)
                 return o_proj(out), dict(cache, k=pk, v=pv)
             import math as _math
 
@@ -923,7 +948,7 @@ class Transformer(nn.Module):
         return self.decode(tokens, caches, pos, last_idx=last_idx)
 
     def decode_paged(self, tokens, pcaches, table, pos, last_only=False,
-                     last_idx=None, hw_blocks=None):
+                     last_idx=None, hw_blocks=None, tp=1):
         """`decode` against a **paged** KV cache: one slot's contiguous
         cache rows are gathered from the per-layer block pools
         (``pcaches``: ``[n_blocks, block, ...]`` per layer) via the
@@ -946,17 +971,28 @@ class Transformer(nn.Module):
         unwritten padding every tick.  Bit-exact for any ``hw_blocks``
         covering ``pos + tq``: the dropped tail is exactly the masked
         region whose scores contribute zero probability mass.
+
+        ``tp`` (static int) gathers from tensor-parallel per-shard
+        pools, reassembling the unsharded flat row exactly — see
+        :func:`gather_paged_rows`; the caller slices the written span
+        and re-splits it per shard at scatter time.
         """
-        rows = gather_paged_rows(pcaches, table, hw_blocks=hw_blocks)
+        rows = gather_paged_rows(pcaches, table, hw_blocks=hw_blocks,
+                                 tp=tp)
+        if tp > 1:
+            rows = _regroup_tp_rows(self.cfg, rows)
         return self.decode(tokens, rows, pos, last_only=last_only,
                            last_idx=last_idx)
 
-    def prefill_chunk_paged(self, tokens, pcaches, table, pos, last_idx):
+    def prefill_chunk_paged(self, tokens, pcaches, table, pos, last_idx,
+                            tp=1):
         """``prefill_chunk`` over a paged cache: gather the slot's rows
         through its block table, run the position-offset chunk, return
         the written rows for the caller's scatter-back (see
         :meth:`decode_paged`)."""
-        rows = gather_paged_rows(pcaches, table)
+        rows = gather_paged_rows(pcaches, table, tp=tp)
+        if tp > 1:
+            rows = _regroup_tp_rows(self.cfg, rows)
         return self.prefill_chunk(tokens, rows, pos, last_idx)
 
     def decode_paged_fused(self, tokens, pcaches, tables, pos, wblk,
@@ -1017,18 +1053,38 @@ class Transformer(nn.Module):
         return self.decode(tokens, caches, pos)
 
     def verify_tokens_paged(self, tokens, pcaches, table, pos,
-                            hw_blocks=None):
+                            hw_blocks=None, tp=1):
         """:meth:`verify_tokens` over a paged cache: gather the slot's
         rows through its block table, verify the ``k + 1`` positions in
         one pass, return ``(logits [B, k+1, vocab], written rows)`` for
         the caller's per-position scatter-back (see
         :meth:`decode_paged`; ``hw_blocks`` caps the gather at the
         high-water block, which must cover ``pos + k + 1``)."""
-        rows = gather_paged_rows(pcaches, table, hw_blocks=hw_blocks)
+        rows = gather_paged_rows(pcaches, table, hw_blocks=hw_blocks,
+                                 tp=tp)
+        if tp > 1:
+            rows = _regroup_tp_rows(self.cfg, rows)
         return self.decode(tokens, rows, pos)
 
 
-def gather_paged_rows(pcaches, table, hw_blocks=None):
+def _regroup_tp_rows(cfg, rows):
+    """Reshape tp-gathered FLAT k/v rows ``[B, S, KV*D]`` to the
+    grouped ``[B, S, KV, D]`` layout (scale leaves stay ``[B, S,
+    KV]``).  The flat minor axis is head-major, so this reshape is a
+    pure view — the regrouped row is byte-identical to a grouped
+    gather.  It routes the tensor-parallel gather fallback onto the
+    grouped dense attention branch (the exact program an unsharded
+    grouped-layout engine runs) instead of the flat-row branch, whose
+    single-token step takes the fused dense decode kernel — not a
+    fallback path off-TPU."""
+    KV, D = cfg.kv_heads, cfg.d_head
+    return tuple(
+        {n: (r[n].reshape(r[n].shape[:2] + (KV, D))
+             if n in ("k", "v") else r[n]) for n in r}
+        for r in rows)
+
+
+def gather_paged_rows(pcaches, table, hw_blocks=None, tp=1):
     """Assemble one slot's contiguous cache view from paged per-layer
     block pools: ``c [n_blocks, block, ...]`` indexed by the slot's
     block table ``[max_blocks]`` -> ``[1, max_blocks * block, ...]``.
@@ -1047,16 +1103,32 @@ def gather_paged_rows(pcaches, table, hw_blocks=None):
     masked stale content), so the serving engine caps the gather at a
     bucketed high-water instead of streaming the full table width each
     tick; the shorter row stays value-identical over the admitted
-    (masked-in) region."""
+    (masked-in) region.
+
+    ``tp > 1`` gathers from **tensor-parallel** per-shard flat pools
+    ``[tp, n_blocks, block, X]`` (init_paged_cache tp>1) and
+    reassembles the unsharded FLAT row ``[1, S, tp*X]`` byte-for-byte:
+    the flat minor axis is head-major and shard ``s`` holds exactly
+    KV-head slice ``s``, so concatenating the shards' minor axes at
+    each position IS the unsharded row (docs/parallel.md).  The dense
+    attention the gathered row feeds is therefore the IDENTICAL
+    program the unsharded gather path runs — tp gather parity needs no
+    new attention code (the flat-row dense path already serves chunk
+    prefill on fused engines)."""
     if hw_blocks is not None:
         table = table[..., :hw_blocks]
     out = []
     for layer in pcaches:
         row = {}
         for name, c in layer.items():
-            g = c[table]  # [hw_blocks, block, ...]
-            row[name] = g.reshape(
-                (1, g.shape[0] * g.shape[1]) + g.shape[2:])
+            if tp > 1:
+                g = c[:, table]  # [tp, hw_blocks, block, X]
+                row[name] = g.transpose(1, 2, 0, 3).reshape(
+                    1, g.shape[1] * g.shape[2], tp * g.shape[3])
+            else:
+                g = c[table]  # [hw_blocks, block, ...]
+                row[name] = g.reshape(
+                    (1, g.shape[0] * g.shape[1]) + g.shape[2:])
         out.append(row)
     return tuple(out)
 
@@ -1092,17 +1164,23 @@ def init_cache(cfg: TransformerConfig, batch_size: int, max_len: int,
       per decode step would crawl).
 
     **Tensor-parallel decode**: when ``cfg.mesh`` carries an active tp
-    axis that divides ``kv_heads``, the grouped cache is sharded over
-    its head axis (``P(dp?, None, tp, ...)``) — each tp shard then
-    holds, writes, and streams only its own KV heads, so serving a
+    axis that divides ``kv_heads``, the cache is sharded over its
+    KV-head axis — the grouped layout's explicit head dim
+    (``P(dp?, None, tp, ...)``, ``_grouped_cache_sharding``) or the
+    flat layout's head-major minor axis in whole-head slices
+    (``P(dp?, None, tp)``, ``_flat_cache_sharding``) — so each tp
+    shard holds, writes, and streams only its own KV heads: serving a
     model too big for one chip splits the cache (and its decode HBM
     stream) the same way it splits the weights; the o-projection's
     row-parallel annotation gives GSPMD the psum that merges the
-    per-shard attention outputs.  When tp does not divide ``kv_heads``
-    (MQA under tp), the cache stays replicated, matching the
-    replicated k/v kernels ``Attention`` falls back to.  See
-    docs/inference.md "Serving topology" for when dp- vs tp-sharding
-    wins.
+    per-shard attention outputs.  When tp does NOT divide
+    ``kv_heads`` (MQA under tp) the grouped cache stays replicated,
+    matching the replicated k/v kernels ``Attention`` falls back to,
+    and ``layout="flat"`` raises (there is no exact whole-head
+    partition of its minor axis to express — pad ``kv_heads`` or use
+    the grouped layout).  See docs/inference.md "Serving topology"
+    for when dp- vs tp-sharding wins, and docs/parallel.md for the
+    paged per-shard pools.
 
     ``quantized=True`` builds an int8 cache (s8 K/V plus f32
     per-(position, head) scales, grouped or flat): half the HBM bytes
@@ -1119,18 +1197,24 @@ def init_cache(cfg: TransformerConfig, batch_size: int, max_len: int,
         names = cfg.mesh.axis_names
         tp = cfg.tp_axis
         if (tp in names and cfg.mesh.shape[tp] > 1
-                and KV % cfg.mesh.shape[tp] == 0):
-            # the grouped path would shard the KV head axis over tp
-            # (_grouped_cache_sharding); the flat [B, S, KV*D] stream has
-            # no head axis to shard, so honoring the request would
-            # silently collapse the per-shard KV streams onto every
-            # device — refuse instead (layout="auto" already routes
-            # sharded decode to the grouped path)
+                and KV % cfg.mesh.shape[tp]):
+            # the flat [B, S, KV*D] minor axis is head-major, so it
+            # shards over tp in whole-KV-head slices ONLY: when tp
+            # divides kv_heads the flat cache tp-shards exactly like
+            # the grouped one (each contiguous KV*D/tp chunk IS one
+            # shard's head slice — _flat_cache_sharding below), but
+            # when it doesn't there is no exact head partition to
+            # express, so honoring the request would silently
+            # replicate what the caller asked to shard — refuse with
+            # the two honest ways out instead
             raise ValueError(
-                f'layout="flat" is incompatible with an active tensor-'
-                f'parallel axis {tp!r} (size {cfg.mesh.shape[tp]}) '
-                f'dividing kv_heads={KV}; use layout="auto" or "grouped" '
-                f'for sharded decode')
+                f'layout="flat" under an active tensor-parallel axis '
+                f'{tp!r} (size {cfg.mesh.shape[tp]}) requires the axis '
+                f'to divide kv_heads={KV}: the flat [B, S, KV*D] minor '
+                f'axis shards in whole KV-head slices only; use '
+                f'layout="grouped" (replicated K/V cache, matching the '
+                f'replicated k/v kernels Attention falls back to) or '
+                f'pad kv_heads to a multiple of the tp size')
     if layout == "auto":
         from ..ops.decode_attention import decode_attention_usable
 
@@ -1148,23 +1232,22 @@ def init_cache(cfg: TransformerConfig, batch_size: int, max_len: int,
         layout = "flat" if use_flat else "grouped"
     if layout == "flat":
         shape = (batch_size, max_len, KV * D)
+        fshard = _flat_cache_sharding(cfg, batch_size)
         if quantized:
             # flat int8: s8 values in the kernel's contiguous stream
             # layout plus the per-(position, head) f32 scales — the
             # fused decode kernel dequantizes in VMEM
             # (ops/decode_attention.py k_scale/v_scale)
-            return tuple(
-                {"k": jnp.zeros(shape, jnp.int8),
-                 "v": jnp.zeros(shape, jnp.int8),
-                 "k_scale": jnp.zeros(shape[:2] + (KV,), jnp.float32),
-                 "v_scale": jnp.zeros(shape[:2] + (KV,), jnp.float32)}
-                for _ in range(cfg.num_layers)
-            )
-        return tuple(
-            {"k": jnp.zeros(shape, cfg.dtype),
-             "v": jnp.zeros(shape, cfg.dtype)}
-            for _ in range(cfg.num_layers)
-        )
+            flayer = lambda: {  # noqa: E731
+                "k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "k_scale": jnp.zeros(shape[:2] + (KV,), jnp.float32),
+                "v_scale": jnp.zeros(shape[:2] + (KV,), jnp.float32)}
+        else:
+            flayer = lambda: {  # noqa: E731
+                "k": jnp.zeros(shape, cfg.dtype),
+                "v": jnp.zeros(shape, cfg.dtype)}
+        return tuple(fshard(flayer()) for _ in range(cfg.num_layers))
     shape = (batch_size, max_len, KV, D)
     if quantized:
         layer = lambda: {  # noqa: E731
@@ -1201,6 +1284,40 @@ def _grouped_cache_sharding(cfg: TransformerConfig, batch_size: int):
     from jax.sharding import NamedSharding
 
     spec = {"k": P(dp, None, tp, None), "v": P(dp, None, tp, None),
+            "k_scale": P(dp, None, tp), "v_scale": P(dp, None, tp)}
+
+    def shard(layer):
+        return {name: jax.lax.with_sharding_constraint(
+                    val, NamedSharding(mesh, spec[name]))
+                for name, val in layer.items()}
+
+    return shard
+
+
+def _flat_cache_sharding(cfg: TransformerConfig, batch_size: int):
+    """Constraint mapping a FLAT cache layer onto ``cfg.mesh`` —
+    identity when no active tp axis divides the kv heads.  The flat
+    ``[B, S, KV*D]`` minor axis is head-major, so sharding it into tp
+    contiguous chunks IS sharding the KV-head axis: chunk ``s`` holds
+    exactly heads ``[s*KV/tp, (s+1)*KV/tp)`` (what ``init_cache``
+    refused before the per-shard paged pools made the flat-under-tp
+    story real; docs/parallel.md).  Scale rows ``[B, S, KV]`` shard
+    the same head slices."""
+    mesh = cfg.mesh
+    if mesh is None:
+        return lambda layer: layer
+    names = mesh.axis_names
+    tp = (cfg.tp_axis if cfg.tp_axis in names
+          and mesh.shape[cfg.tp_axis] > 1
+          and cfg.kv_heads % mesh.shape[cfg.tp_axis] == 0 else None)
+    dp = (cfg.dp_axis if cfg.dp_axis in names
+          and mesh.shape[cfg.dp_axis] > 1
+          and batch_size % mesh.shape[cfg.dp_axis] == 0 else None)
+    if tp is None and dp is None:
+        return lambda layer: layer
+    from jax.sharding import NamedSharding
+
+    spec = {"k": P(dp, None, tp), "v": P(dp, None, tp),
             "k_scale": P(dp, None, tp), "v_scale": P(dp, None, tp)}
 
     def shard(layer):

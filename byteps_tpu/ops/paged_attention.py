@@ -303,6 +303,52 @@ def paged_decode_attention(q, ck, cv, table, pos, *,
     return out
 
 
+def paged_decode_attention_sharded(q, ck, cv, table, pos, *,
+                                   k_scale=None, v_scale=None,
+                                   window: Optional[int] = None,
+                                   interpret=None):
+    """:func:`paged_decode_attention` over **tensor-parallel** per-shard
+    block pools: ``ck/cv [tp, n_blocks, block, (KV/tp)*D]`` (int8 adds
+    per-shard scale pools ``[tp, n_blocks, block, KV/tp]``).
+
+    Head slicing is an *exact* partition of the unsharded kernel, not
+    an approximation: the block-diagonal query is laid out group-major
+    (``grp = repeat(arange(KV), G)``), so query-head slice
+    ``[s*H/tp, (s+1)*H/tp)`` interacts with exactly KV-group slice
+    ``[s*KV/tp, (s+1)*KV/tp)`` and no other — shard ``s``'s kernel
+    call performs bit-for-bit the same per-row arithmetic (same chunk
+    order, same online-softmax carries) as the corresponding row slice
+    of the unsharded call, and the head-axis concat reassembles the
+    unsharded output exactly.  One static Python loop, ``tp`` kernel
+    calls per step; under a real tp mesh each call's operands live on
+    shard ``s``'s device and the loop is the per-device program
+    (docs/parallel.md — the o-projection's row-parallel psum merges
+    the outputs there; on one host the concat below is that merge).
+
+    The block table and cursor vector are REPLICATED across shards —
+    paging is head-agnostic, which is what lets COW / prefix sharing /
+    preempt-resume bookkeeping stay single-copy (serving/blocks.py).
+    """
+    B, tq, H, D = q.shape
+    tp = ck.shape[0]
+    if cv.shape != ck.shape:
+        raise ValueError(f"k/v pool shape mismatch: {ck.shape} vs "
+                         f"{cv.shape}")
+    if H % tp:
+        raise ValueError(
+            f"tp ({tp}) must divide num_heads ({H})")
+    Hs = H // tp
+    quant = k_scale is not None
+    outs = []
+    for s in range(tp):
+        outs.append(paged_decode_attention(
+            q[:, :, s * Hs:(s + 1) * Hs, :], ck[s], cv[s], table, pos,
+            k_scale=(k_scale[s] if quant else None),
+            v_scale=(v_scale[s] if quant else None),
+            window=window, interpret=interpret))
+    return jnp.concatenate(outs, axis=2)
+
+
 def paged_attention_usable(q_shape, block: int, kvd: int) -> bool:
     """Static gate for the engine's ``paged_kernel="auto"`` resolution:
     the f32 accumulator ``[tq*H (pad 16), KV*D]`` must stay a small

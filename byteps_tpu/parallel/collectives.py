@@ -341,6 +341,36 @@ def local_reduce_scatter(stacked, mesh: Mesh, axis) -> jax.Array:
     return fn(jnp.asarray(stacked))
 
 
+def reduce_scatter_spans(stacked, mesh: Mesh, axis) -> List[np.ndarray]:
+    """Sum per-worker rows on-mesh and hand back the per-rank OWNED
+    spans: ``[rank r's span of sum(stacked, axis=0)]`` with the same
+    ceil-chunk span layout as ``zero_spans``/``hierarchical.slice_spans``
+    (span r = ``flat[r*ceil(n/world):(r+1)*ceil(n/world)]``, last span
+    clipped).  Unlike :func:`local_reduce_scatter` this pads internally,
+    so any row length works.
+
+    This is the gradient-reduction front half of a ZeRO step
+    (training/zero.py): after it, rank r holds exactly the summed
+    gradient for the parameter span whose optimizer state it owns — at
+    1/world of the allreduce's gather traffic, since no rank ever needs
+    the other spans' gradients."""
+    axes = _axes_tuple(axis)
+    world = _axes_size(mesh, axes)
+    stacked = np.asarray(stacked)
+    if stacked.ndim != 2 or stacked.shape[0] != world:
+        raise ValueError(
+            f"reduce_scatter_spans expects [axis_size={world}, n]; got "
+            f"{stacked.shape}")
+    n = stacked.shape[1]
+    chunk = -(-n // world) if n else 0
+    pad = chunk * world - n
+    if pad:
+        stacked = np.concatenate(
+            [stacked, np.zeros((world, pad), stacked.dtype)], axis=1)
+    flat = np.asarray(local_reduce_scatter(stacked, mesh, axes))
+    return [flat[r * chunk:min((r + 1) * chunk, n)] for r in range(world)]
+
+
 @functools.lru_cache(maxsize=None)
 def _local_gather_fn(mesh: Mesh, axes: Tuple[str, ...], npad: int,
                      dtype: str):

@@ -213,7 +213,8 @@ class BlockTable:
 
 
 def init_paged_cache(cfg: TransformerConfig, n_blocks: int, block: int,
-                     layout: str = "grouped", kv_dtype: str = ""):
+                     layout: str = "grouped", kv_dtype: str = "",
+                     tp: int = 1):
     """Zeroed paged KV cache: per layer ``{"k","v"}``.
 
     * ``"grouped"`` — ``[n_blocks, block, kv_heads, d_head]``: the
@@ -238,19 +239,46 @@ def init_paged_cache(cfg: TransformerConfig, n_blocks: int, block: int,
     path (``_cached_attention_q8``) — quantize-at-write on BOTH, so
     the two paths read identical stored bytes.
 
+    ``tp > 1`` builds **per-shard** flat pools: a leading tp axis over
+    pools of ``(kv_heads / tp) * d_head``-wide blocks — shard ``s``
+    holds exactly KV-head slice ``[s * KV/tp, (s+1) * KV/tp)``, in the
+    same head-major flat order, so concatenating the shards' minor
+    axes reproduces the unsharded flat block byte-for-byte.  Total
+    bytes are unchanged (the lever is per-*device* bytes under a real
+    tp mesh); only the physically flat layouts can shard this way —
+    the grouped layout's tp story is the dense grouped cache
+    (``init_cache``), not the block pool.
+
     (The legacy dense ``kv_quant`` knob is refused upstream for paged
     engines — ``kv_dtype`` is the paged quantization path.)"""
     KV, D = cfg.kv_heads, cfg.d_head
+    if tp < 1:
+        raise ValueError(f"tp must be >= 1, got {tp}")
+    if tp > 1:
+        if KV % tp:
+            raise ValueError(
+                f"tensor-parallel paged cache requires tp ({tp}) to "
+                f"divide kv_heads ({KV}): a KV head is the unit of "
+                f"exact attention partitioning")
+        if layout != "flat" and kv_dtype != "int8":
+            raise ValueError(
+                f'tensor-parallel paged cache requires the flat block '
+                f'layout (per-shard [n_blocks, block, (kv_heads/tp)*'
+                f'd_head] pools), got layout={layout!r}')
+    KVs = KV // tp
+    lead = (tp,) if tp > 1 else ()
     if kv_dtype == "int8":
-        shape = (n_blocks, block, KV * D)
+        shape = lead + (n_blocks, block, KVs * D)
         return tuple(
             {"k": jnp.zeros(shape, jnp.int8),
              "v": jnp.zeros(shape, jnp.int8),
-             "k_scale": jnp.zeros((n_blocks, block, KV), jnp.float32),
-             "v_scale": jnp.zeros((n_blocks, block, KV), jnp.float32)}
+             "k_scale": jnp.zeros(lead + (n_blocks, block, KVs),
+                                  jnp.float32),
+             "v_scale": jnp.zeros(lead + (n_blocks, block, KVs),
+                                  jnp.float32)}
             for _ in range(cfg.num_layers)
         )
-    shape = ((n_blocks, block, KV * D) if layout == "flat"
+    shape = (lead + (n_blocks, block, KVs * D) if layout == "flat"
              else (n_blocks, block, KV, D))
     return tuple(
         {"k": jnp.zeros(shape, cfg.dtype),
@@ -274,12 +302,20 @@ class PagedSlotPool(SlotPool):
     (``BYTEPS_SERVE_KV_MB``), or — default — the dense-equivalent
     ``n_slots * max_seq / block`` plus the null block, which makes a
     knob-free paged engine hold exactly what the dense engine holds.
+
+    ``tp > 1`` (``BYTEPS_TP``) shards the pool per KV-head slice
+    (:func:`init_paged_cache`): allocator, tables, refcounts, and the
+    sizing math are unchanged — a block id names the same token span
+    on every shard, and ``block_bytes`` stays the TOTAL across shards
+    (the per-device bytes under a real tp mesh are ``block_bytes /
+    tp``; docs/parallel.md).
     """
 
     def __init__(self, cfg: TransformerConfig, n_slots: int, max_seq: int,
                  *, block: int = 16, n_blocks: Optional[int] = None,
                  kv_bytes: int = 0, kv_quant: bool = False,
-                 kv_dtype: str = "", layout: str = "grouped"):
+                 kv_dtype: str = "", layout: str = "grouped",
+                 tp: int = 1):
         if kv_quant:
             raise ValueError(
                 "the legacy kv_quant knob quantizes the dense cache and"
@@ -306,6 +342,22 @@ class PagedSlotPool(SlotPool):
                 f"size {block}: the gathered row must be exactly "
                 f"max_seq wide so the paged attention program is "
                 f"shape-identical to the dense engine's")
+        if tp < 1:
+            raise ValueError(f"tp must be >= 1, got {tp}")
+        if tp > 1:
+            if cfg.kv_heads % tp:
+                raise ValueError(
+                    f"tensor-parallel paged pool requires tp ({tp}) to "
+                    f"divide kv_heads ({cfg.kv_heads}); pad kv_heads or "
+                    f"serve unsharded")
+            if layout not in ("flat", "auto") and kv_dtype != "int8":
+                raise ValueError(
+                    f'tensor-parallel paged pool requires the flat '
+                    f'block layout (per-shard flat pools shard the '
+                    f'head-major minor axis exactly), got '
+                    f'layout={layout!r}')
+            layout = "flat" if kv_dtype != "int8" else layout
+        self.tp = tp
         self.block = block
         self.kv_dtype = kv_dtype
         self.max_blocks = max_seq // block
@@ -356,7 +408,7 @@ class PagedSlotPool(SlotPool):
     def _init_caches(self):
         return init_paged_cache(self.cfg, self._n_blocks, self.block,
                                 layout=self.layout,
-                                kv_dtype=self.kv_dtype)
+                                kv_dtype=self.kv_dtype, tp=self.tp)
 
     # ------------------------------------------------------------ lifecycle
 

@@ -327,6 +327,7 @@ class ServingEngine:
                  kv_blocks: Optional[int] = None,
                  kv_dtype: str = "",
                  paged_kernel: str = "auto",
+                 tp: int = 0,
                  spec_k: int = 0,
                  spec_ngram: int = 3,
                  metrics: Optional[ServeMetrics] = None):
@@ -537,12 +538,35 @@ class ServingEngine:
             self.spec = NgramProposer(k, max(2, spec_ngram))
         else:
             self.spec = None
+        # tensor-parallel serving: tp > 1 shards the paged block pool
+        # into per-KV-head-slice sub-pools ([tp, n_blocks, block,
+        # (KV/tp)*D] — serving/blocks.py).  0 defers to the BYTEPS_TP
+        # config knob; 1 serves unsharded.  Attention is exactly
+        # partitioned by KV head (docs/parallel.md), so the sharded
+        # engine's token stream is identical to the unsharded one.
+        if not tp:
+            from ..common.config import get_config as _gc
+            tp = max(1, int(getattr(_gc(), "serve_tp", 1)))
+        if tp > 1:
+            if not self.paged:
+                raise ValueError(
+                    f"tp ({tp}) > 1 requires paged=True: tensor-"
+                    f"parallel serving shards the paged block pool per "
+                    f"KV-head slice; dense slot caches shard through "
+                    f"init_cache's mesh path instead")
+            if cfg.num_heads % tp:
+                raise ValueError(
+                    f"tp ({tp}) must divide num_heads "
+                    f"({cfg.num_heads}) so query head slices align "
+                    f"with KV head slices")
+        self.tp = tp
         if self.paged:
             self.pool = PagedSlotPool(
                 cfg, n_slots, self.max_seq, block=block,
                 n_blocks=kv_blocks, kv_bytes=kv_mb << 20,
-                kv_quant=kv_quant, kv_dtype=kv_dtype,
-                layout=("flat" if self.paged_kernel else cache_layout))
+                kv_quant=kv_quant, kv_dtype=kv_dtype, tp=tp,
+                layout=("flat" if (self.paged_kernel or tp > 1)
+                        else cache_layout))
         else:
             self.pool = SlotPool(cfg, n_slots, self.max_seq,
                                  kv_quant=kv_quant, layout=cache_layout)
@@ -789,6 +813,7 @@ class ServingEngine:
         model, greedy = self.model, self.greedy
         pad_id = self.pad_id
         select = self._select_token
+        tp = self.tp
 
         if hw is None:
             def decode_fn(variables, pcaches, tok, pos, active, keys,
@@ -811,7 +836,8 @@ class ServingEngine:
             def one(variables, pcaches, table, tok, pos, key):
                 logits, new_rows = model.apply(
                     variables, tok[None, None], pcaches, table, pos,
-                    hw_blocks=hw, method=Transformer.decode_paged)
+                    hw_blocks=hw, tp=tp,
+                    method=Transformer.decode_paged)
                 nxt, nk = select(logits[:, -1], key)
                 # the one written position, sliced back out of the
                 # gathered row for the pool scatter below
@@ -834,9 +860,20 @@ class ServingEngine:
                     keys2 = jnp.where(active[:, None], keys2, keys)
                 else:
                     keys2 = keys
-                new_pc = tuple(
-                    {n: pc[n].at[wblk, woff].set(fr[n]) for n in pc}
-                    for pc, fr in zip(pcaches, fresh))
+                if tp == 1:
+                    new_pc = tuple(
+                        {n: pc[n].at[wblk, woff].set(fr[n]) for n in pc}
+                        for pc, fr in zip(pcaches, fresh))
+                else:
+                    # fresh leaves are head-major ([N, KV, D] values /
+                    # [N, KV] scales): splitting the head axis into tp
+                    # contiguous slices is exactly the per-shard
+                    # partition of the unsharded row's bytes
+                    new_pc = tuple(
+                        {n: pc[n].at[:, wblk, woff].set(
+                            fr[n].reshape(fr[n].shape[0], tp, -1)
+                            .transpose(1, 0, 2)) for n in pc}
+                        for pc, fr in zip(pcaches, fresh))
                 return new_pc, nxt, keys2
 
         fn = jax.jit(decode_fn, donate_argnums=(1,))
@@ -948,6 +985,7 @@ class ServingEngine:
             return fn
         model = self.model
         select = self._select_token
+        tp = self.tp
 
         def chain(lg, key):
             """Per-slot select chain over ``lg [tq, vocab]``."""
@@ -975,7 +1013,7 @@ class ServingEngine:
             def one(variables, pcaches, table, toks, pos, key):
                 logits, new_rows = model.apply(
                     variables, toks[None, :], pcaches, table, pos,
-                    hw_blocks=hw,
+                    hw_blocks=hw, tp=tp,
                     method=Transformer.verify_tokens_paged)
                 ts, ks = chain(logits[0], key)
                 # the tq written positions, sliced back out of the
@@ -994,9 +1032,18 @@ class ServingEngine:
                 fresh, tmat, kchain = jax.vmap(
                     one, in_axes=(None, None, 0, 0, 0, 0))(
                         variables, pcaches, tables, toks, pos, keys)
-                new_pc = tuple(
-                    {n: pc[n].at[wblk, woff].set(fr[n]) for n in pc}
-                    for pc, fr in zip(pcaches, fresh))
+                if tp == 1:
+                    new_pc = tuple(
+                        {n: pc[n].at[wblk, woff].set(fr[n]) for n in pc}
+                        for pc, fr in zip(pcaches, fresh))
+                else:
+                    # per-position head-major split, as in the decode
+                    # scatter, one query-width axis wider
+                    new_pc = tuple(
+                        {n: pc[n].at[:, wblk, woff].set(
+                            fr[n].reshape(fr[n].shape[0], tq, tp, -1)
+                            .transpose(2, 0, 1, 3)) for n in pc}
+                        for pc, fr in zip(pcaches, fresh))
                 return (new_pc,) + self._verify_accept(
                     props, tmat, kchain, prop_len, active, tok, keys,
                     budget)
@@ -1023,13 +1070,14 @@ class ServingEngine:
         mb = self.pool.max_blocks
         null = self.pool.null_block
         nb_touch = (bucket - 1) // blk + 2
+        tp = self.tp
 
         def chunk_fn(variables, pcaches, tokens, table, start, last_idx,
                      key):
             self.chunk_traces += 1  # trace-time only
             logits, new_rows = model.apply(
                 variables, tokens, pcaches, table, start, last_idx,
-                method=Transformer.prefill_chunk_paged)
+                tp=tp, method=Transformer.prefill_chunk_paged)
             tok0, nk = select(logits[:, -1], key)
             first = start // blk
             new_pc = []
@@ -1042,7 +1090,12 @@ class ServingEngine:
                         src = jax.lax.dynamic_slice_in_dim(
                             nr[n], safe * blk, blk, axis=1)[0]
                         bid = jnp.where(idx < mb, table[safe], null)
-                        c = c.at[bid].set(src)
+                        if tp == 1:
+                            c = c.at[bid].set(src)
+                        else:
+                            c = c.at[:, bid].set(
+                                src.reshape(blk, tp, -1)
+                                .transpose(1, 0, 2))
                     out[n] = c
                 new_pc.append(out)
             return tuple(new_pc), tok0, nk
@@ -1056,11 +1109,21 @@ class ServingEngine:
         (``PagedSlotPool.make_writable``): one compiled program for
         every (src, dst) pair."""
         if self._cow_fn is None:
-            def cow(pcaches, src, dst):
-                self.block_cow_traces += 1  # trace-time only
-                return tuple(
-                    {n: c[n].at[dst].set(c[n][src]) for n in c}
-                    for c in pcaches)
+            if self.tp == 1:
+                def cow(pcaches, src, dst):
+                    self.block_cow_traces += 1  # trace-time only
+                    return tuple(
+                        {n: c[n].at[dst].set(c[n][src]) for n in c}
+                        for c in pcaches)
+            else:
+                def cow(pcaches, src, dst):
+                    self.block_cow_traces += 1  # trace-time only
+                    # block axis is axis 1 behind the shard axis; the
+                    # copy replicates the fork on every shard
+                    return tuple(
+                        {n: c[n].at[:, dst].set(c[n][:, src])
+                         for n in c}
+                        for c in pcaches)
 
             self._cow_fn = jax.jit(cow, donate_argnums=(0,))
         self.pool.caches = self._cow_fn(self.pool.caches,
@@ -1102,11 +1165,26 @@ class ServingEngine:
         layer, each value ``[len(ids), ...block row]`` — the ship
         payload.  Row-major bytes are layout-identical between the
         grouped and flat pool layouts (same trailing element count), so
-        the wire format does not encode the layout."""
+        the wire format does not encode the layout.  A tp-sharded pool
+        reassembles each block's per-shard slices head-major into the
+        unsharded flat row bytes, so ships are tp-count independent:
+        a tp=2 prefill tier can feed an unsharded (or tp=4) decode
+        tier."""
         idx = jnp.asarray(list(ids), jnp.int32)
         with self._lock:
-            return [{n: np.asarray(jnp.take(c[n], idx, axis=0))
-                     for n in c} for c in self.pool.caches]
+            if self.tp == 1:
+                return [{n: np.asarray(jnp.take(c[n], idx, axis=0))
+                         for n in c} for c in self.pool.caches]
+            out = []
+            for c in self.pool.caches:
+                layer = {}
+                for n in c:
+                    g = jnp.take(c[n], idx, axis=1)  # [tp, nb, blk, X]
+                    layer[n] = np.asarray(
+                        g.transpose(1, 2, 0, 3).reshape(
+                            g.shape[1], g.shape[2], -1))
+                out.append(layer)
+            return out
 
     def write_kv_block(self, bid: int, layers) -> None:
         """Scatter ONE received block into the pool at physical id
@@ -1114,10 +1192,24 @@ class ServingEngine:
         shape for a single block (leading axis dropped).  One compiled
         program total — the block id is a traced scalar."""
         if self._kv_write_fn is None:
-            def kv_write(pcaches, bid, blk):
-                return tuple(
-                    {n: c[n].at[bid].set(blk[i][n]) for n in c}
-                    for i, c in enumerate(pcaches))
+            if self.tp == 1:
+                def kv_write(pcaches, bid, blk):
+                    return tuple(
+                        {n: c[n].at[bid].set(blk[i][n]) for n in c}
+                        for i, c in enumerate(pcaches))
+            else:
+                tp = self.tp
+
+                def kv_write(pcaches, bid, blk):
+                    # wire rows arrive in the unsharded head-major flat
+                    # format (extract_kv_blocks); split the minor axis
+                    # back into per-shard KV-head slices
+                    return tuple(
+                        {n: c[n].at[:, bid].set(
+                            blk[i][n].reshape(
+                                blk[i][n].shape[0], tp, -1)
+                            .transpose(1, 0, 2)) for n in c}
+                        for i, c in enumerate(pcaches))
 
             self._kv_write_fn = jax.jit(kv_write, donate_argnums=(0,))
         with self._lock:

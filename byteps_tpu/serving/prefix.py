@@ -346,7 +346,14 @@ class PagedPrefixCache(PrefixCache):
         the boundary key) returns the deepest verified node, whose
         ``buffer`` is the full root..self id chain; the admitted slot's
         table adopts those blocks (refcount bumps) — zero device-side
-        K/V copies, enforced by the engine's compile counters
+        K/V copies, enforced by the engine's compile counters.
+
+    Tensor-parallel pools (``PagedSlotPool(tp=...)``) need no paged-
+    prefix changes at all: a physical block id names the same token
+    span on EVERY shard's sub-pool, so the id chains, refcounts, dedup,
+    and byte accounting above are shard-count-independent — a hit
+    shares all ``tp`` sub-pool blocks with one refcount bump
+    (tests/test_tp_serving.py pins zero-copy hits at tp=2).
         (``prefix_copy``/``prefix_extract`` stay 0);
       * **partial insert under budget**: the walk stores the longest
         affordable prefix of new nodes instead of refusing the whole

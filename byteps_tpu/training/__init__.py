@@ -10,13 +10,18 @@ from .step import (
     lm_loss_fn,
     create_train_state,
     make_data_parallel_step,
+    make_zero_step,
     replicate_state,
     shard_batch,
 )
+from .zero import (ReplicatedOptimizerState, ShardedOptimizerState,
+                   make_optimizer_state)
 
 __all__ = [
     "DistributedOptimizer", "push_pull_gradients",
     "TrainState", "create_train_state", "make_data_parallel_step",
     "shard_batch", "replicate_state", "classification_loss_fn", "lm_loss_fn",
     "OverlapState", "make_delayed_grad_step", "Trainer",
+    "make_zero_step", "ShardedOptimizerState", "ReplicatedOptimizerState",
+    "make_optimizer_state",
 ]

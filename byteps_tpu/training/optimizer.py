@@ -85,6 +85,21 @@ def resolve_local_axis(axes: Sequence[str],
     return local_axis, tuple(a for a in axes if a != local_axis)
 
 
+def sgd_momentum_update(m, g, lr: float, momentum: float):
+    """One heavy-ball SGD step on host numpy: ``m' = momentum*m + g``,
+    ``delta = -lr*m'`` (the parameter increment).  Returns ``(m', delta)``.
+
+    This is the SINGLE update rule both the replicated baseline and the
+    ZeRO-sharded path (training/zero.py) call: it is elementwise, so the
+    owner of a parameter span computing it over just that span produces
+    bytes bitwise-identical to a replicated client computing the full
+    tensor and slicing — the bit-equality contract tests/test_zero.py
+    pins.  Keep it numpy (not jnp): the eager PS data path is host-side,
+    and both legs must share one arithmetic, not two lowerings of it."""
+    m = momentum * m + g
+    return m, (-lr) * m
+
+
 def push_pull_gradients(
     axis_name: Union[str, Sequence[str], None] = "dp",
     average: bool = True,

@@ -214,6 +214,40 @@ class TrainStep:
         return self._fn.lower(state, batch)
 
 
+def make_zero_step(loss_fn, zero, model_state=None, reduce_grads=None):
+    """Eager ZeRO-1 train step over the PS tier (training/zero.py).
+
+    ``loss_fn(params, model_state, batch) -> (loss, new_model_state)``
+    with ``params`` a flat ``{name: array}`` dict (the replica ``zero``
+    holds); the backward pass is jitted, the optimizer/wire half runs
+    on the host through ``zero.step`` (push owned span deltas, pull the
+    rest — docs/parallel.md).  Returns ``step(batch) -> loss``.
+
+    ``reduce_grads`` maps this worker's raw gradients to the
+    group-reduced gradients ``zero.step`` requires (e.g. stacking over
+    colocated workers through ``collectives.reduce_scatter_spans``, or
+    an allreduce); None means the gradients are already reduced — the
+    single-worker / pre-reduced harness case.  Mutable model state is
+    not threaded (this is the eager PS path, not
+    ``make_data_parallel_step``); pass BN-free losses."""
+    import numpy as np
+
+    ms = {} if model_state is None else model_state
+
+    grad_fn = jax.jit(jax.value_and_grad(
+        lambda p, b: loss_fn(p, ms, b)[0]))
+
+    def step(batch):
+        loss, grads = grad_fn(zero.params, batch)
+        g = {n: np.asarray(v) for n, v in grads.items()}
+        if reduce_grads is not None:
+            g = reduce_grads(g)
+        zero.step(g)
+        return float(loss)
+
+    return step
+
+
 def shard_batch(batch, mesh: Mesh, axes: Sequence[str] = ("dp",)):
     """Place a host batch on the mesh, dim 0 sharded over ``axes``."""
     sharding = NamedSharding(mesh, P(tuple(axes)))

@@ -27,7 +27,8 @@ import time
 
 import pytest
 
-from byteps_tpu.analysis import envknobs, locks, metricnames, protocols
+from byteps_tpu.analysis import (envknobs, locks, metricnames,
+                                 partitionspecs, protocols)
 from byteps_tpu.analysis import runtime as lockrt
 from byteps_tpu.analysis.runner import BASELINE_FILE, repo_root, run_all
 from byteps_tpu.analysis.violations import (Baseline, Violation,
@@ -294,6 +295,51 @@ e = os.environ.get(name)             # dynamic key
         assert vs[0].detail == "BYTEPS_NEW_KNOB"
         assert envknobs.check_env_docs(
             cfg, docs + "| `BYTEPS_NEW_KNOB` | ... |\n") == []
+
+
+class TestPartitionSpecRules:
+    ROSTER = {"dp", "tp", "sp"}
+
+    def test_unknown_literal_axis_flagged_under_alias(self):
+        src = '''
+from jax.sharding import PartitionSpec as P
+
+def make(mesh):
+    good = P("dp", None, "tp")
+    bad = P("model", None)
+    nested = P(("dp", "tpp"))
+    kw = P(axis="data")
+'''
+        vs = partitionspecs.analyze_pspec_source(
+            src, "byteps_tpu/x.py", self.ROSTER)
+        assert _rules(vs) == ["pspec-unknown-axis"] * 3
+        assert sorted(v.detail for v in vs) == ["data", "model", "tpp"]
+        assert all(v.symbol == "make" for v in vs)
+
+    def test_clean_and_unaliased_modules_pass(self):
+        src = '''
+from jax.sharding import PartitionSpec
+
+spec = PartitionSpec("dp", ("tp", "sp"), None)
+'''
+        assert partitionspecs.analyze_pspec_source(
+            src, "byteps_tpu/x.py", self.ROSTER) == []
+        # P that is NOT the PartitionSpec import must not be touched
+        other = '''
+def P(*a):
+    return a
+
+x = P("model")
+'''
+        assert partitionspecs.analyze_pspec_source(
+            other, "byteps_tpu/x.py", self.ROSTER) == []
+
+    def test_roster_extraction_from_real_mesh_module(self):
+        with open(os.path.join(REPO, "byteps_tpu/parallel/mesh.py")) as f:
+            roster = partitionspecs.mesh_axis_roster(f.read())
+        assert {"dp", "tp", "dcn"} <= roster
+        with pytest.raises(ValueError, match="AXIS_ORDER"):
+            partitionspecs.mesh_axis_roster("x = 1\n")
 
 
 class TestMetricRules:
@@ -640,6 +686,9 @@ def test_update_baseline_rule_filter_preserves_other_rules(tmp_path):
                 "docs/env.md", "docs/observability.md",
                 "docs/wire.md", "docs/serving.md"):
         (root / rel).write_text("")
+    (root / "byteps_tpu" / "parallel").mkdir()
+    (root / "byteps_tpu" / "parallel" / "mesh.py").write_text(
+        'AXIS_ORDER = ("dp", "tp")\n')
     (root / "byteps_tpu" / "bad.py").write_text(
         'import os, threading, time\n'
         'F = os.environ.get("BYTEPS_FAKE", "")\n'

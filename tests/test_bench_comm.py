@@ -68,3 +68,26 @@ def test_bench_comm_hierarchical_ab_meets_bar():
     with open(os.path.join(REPO, "BENCH_COMM.json")) as f:
         archived = {r["metric"] for r in json.load(f)["rows"]}
     assert "hierarchical_wire_bytes_per_step" in archived
+
+
+@pytest.mark.slow
+def test_bench_comm_zero_ab_meets_bar():
+    """ISSUE 20 acceptance: ZeRO-1 optimizer-state sharding at world=2
+    cuts per-rank mutation wire bytes AND client optimizer-state bytes
+    by >= 1.8x vs the replicated loop, with bit-equal final params,
+    and the row is archived."""
+    proc = subprocess.run(
+        [sys.executable, "bench_comm.py", "--zero"],
+        cwd=REPO, capture_output=True, text=True, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rows = [json.loads(line) for line in proc.stdout.splitlines()
+            if line.startswith("{")]
+    row = next(r for r in rows
+               if r["metric"] == "zero_mutation_bytes_per_rank_step")
+    assert row["bit_equal"] is True, row
+    assert row["byte_reduction_x"] >= 0.9 * row["world"], row
+    assert row["state_bytes_reduction_x"] >= 0.9 * row["world"], row
+    with open(os.path.join(REPO, "BENCH_COMM.json")) as f:
+        archived = {r["metric"] for r in json.load(f)["rows"]}
+    assert "zero_mutation_bytes_per_rank_step" in archived

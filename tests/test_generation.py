@@ -317,6 +317,7 @@ def test_flat_cache_generate_matches_grouped(kv):
                                   np.asarray(out_f["tokens"]))
 
 
+@pytest.mark.slow  # ~11s: token-by-token stepwise reference loop (tier-1 duration budget); flat_cache_generate_matches_grouped keeps flat-layout parity fast
 def test_flat_cache_stepwise_matches_forward():
     """Per-token decode against the flat cache reproduces the full causal
     forward — including the tq>1-at-pos>0 dense fallback (speculative

@@ -44,6 +44,7 @@ def test_logits_match_torch():
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow  # ~11s: HF torch generation loop (tier-1 duration budget); gpt2_arch_trains_with_fused_loss + config mapping stay fast
 def test_greedy_generation_matches_torch():
     hf = _hf_model(seed=3)
     model, variables = load_gpt2(hf)

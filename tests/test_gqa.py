@@ -106,6 +106,7 @@ def test_gqa_decode_matches_full_forward(kv):
             atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow  # ~8s: naive reference decode loop (tier-1 duration budget); groups_of_one_is_mha + grouped_q8_cached stay fast
 def test_gqa_generate_matches_naive_and_int8_cache():
     cfg = TransformerConfig(num_heads=4, num_kv_heads=1, **KW)
     m = Transformer(cfg)

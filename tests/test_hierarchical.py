@@ -98,6 +98,9 @@ def test_slice_name_parsing():
     assert hier.parse_slice_rank("w2@s1", "w") is None
     assert hier.parse_slice_rank("w@sx", "w") is None
     assert hier.is_sliced_name("w@s0") and hier.is_sliced_name("w#p1")
+    # ZeRO span keys (training/zero.py) are already 1/world units: the
+    # hierarchical layer must never re-slice them
+    assert hier.is_sliced_name("w@z1")
     assert not hier.is_sliced_name("plain.w")
 
 
@@ -408,6 +411,44 @@ def test_group_exchange_matches_remote_store_slicing():
     np.testing.assert_allclose(pulled.reshape(-1), np.asarray(out),
                                rtol=1e-6)
     st.close(); srv.shutdown(); srv.server_close()
+
+
+def test_group_exchange_multiprocess_rebuild_branch(monkeypatch):
+    """The multi-process rebuild leg of ``hierarchical_push_pull`` —
+    NamedSharding over the local axis, concat of the addressable ranks'
+    pulled slices, ``make_array_from_process_local_data``, jitted
+    ``all_gather`` — driven on a single controller by mocking the
+    process count.  Every rank is addressable here, so the
+    process-local buffer is the full padded tensor and the branch must
+    reproduce the single-controller short-circuit bit-for-bit (same
+    slice keys on the store, same replicated result)."""
+    import jax
+
+    from byteps_tpu.engine.async_ps import AsyncParameterServer
+
+    mesh = _mesh()
+    stacked = np.stack([_x(10, seed=i) for i in range(4)])
+    ref_store = AsyncParameterServer(use_native=False)
+    ref = np.asarray(hier.hierarchical_push_pull(
+        ref_store, "g", stacked, mesh, min_bytes=1))
+
+    store = AsyncParameterServer(use_native=False)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    out = hier.hierarchical_push_pull(store, "g", stacked, mesh,
+                                      min_bytes=1)
+    monkeypatch.undo()
+    np.testing.assert_array_equal(np.asarray(out), ref)
+    # the wire half is identical to the single-controller path: one
+    # slice key per rank, ragged last slice included
+    assert sorted(store.names()) == [f"g@s{r}" for r in range(4)]
+    # a second exchange through the same branch accumulates (PS
+    # semantics survive the rebuild path)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    out2 = hier.hierarchical_push_pull(store, "g", stacked, mesh,
+                                       min_bytes=1)
+    monkeypatch.undo()
+    np.testing.assert_allclose(np.asarray(out2), 2 * stacked.sum(0),
+                               rtol=1e-6)
 
 
 def test_group_exchange_ineligible_falls_back_unsliced():

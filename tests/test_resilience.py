@@ -846,9 +846,12 @@ def test_flash_attention_none_defaults_still_run():
                                rtol=1e-5, atol=1e-5)
 
 
-def test_init_cache_flat_rejects_active_tp_axis():
-    """Satellite: layout="flat" with an active tp axis dividing kv_heads
-    must refuse (the flat stream cannot shard the head axis)."""
+def test_init_cache_flat_tp_refusal_narrowed():
+    """Satellite: layout="flat" under an active tp axis that DIVIDES
+    kv_heads now shards the head-major minor axis (whole KV-head
+    slices); only a NON-dividing tp axis keeps the typed refusal, and
+    its message names both honest ways out (grouped fallback, head
+    padding) — tests/test_tp_serving.py pins the paged-pool twin."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh
@@ -860,12 +863,23 @@ def test_init_cache_flat_rejects_active_tp_axis():
     cfg = TransformerConfig(vocab_size=32, num_layers=1, num_heads=4,
                             d_model=32, d_ff=64, max_seq_len=32,
                             num_kv_heads=2, dtype=jnp.float32, mesh=mesh)
-    with pytest.raises(ValueError, match="flat"):
-        init_cache(cfg, 2, 16, layout="flat")
+    # tp=2 divides kv_heads=2: flat works and tp-shards the minor axis
+    caches = init_cache(cfg, 2, 16, layout="flat")
+    assert caches[0]["k"].ndim == 3
+    assert caches[0]["k"].sharding.spec[2] == "tp"
     # grouped + auto still fine under the mesh
     caches = init_cache(cfg, 2, 16, layout="grouped")
     assert caches[0]["k"].ndim == 4
     init_cache(cfg, 2, 16, layout="auto")
+    # tp=2 does NOT divide kv_heads=1 (MQA): typed refusal naming the
+    # grouped-layout fallback and the padding option
+    cfg1 = TransformerConfig(vocab_size=32, num_layers=1, num_heads=4,
+                             d_model=32, d_ff=64, max_seq_len=32,
+                             num_kv_heads=1, dtype=jnp.float32, mesh=mesh)
+    with pytest.raises(ValueError, match="divide kv_heads") as ei:
+        init_cache(cfg1, 2, 16, layout="flat")
+    assert 'layout="grouped"' in str(ei.value)
+    assert "pad kv_heads" in str(ei.value)
     # and flat stays available without a mesh
     cfg2 = TransformerConfig(vocab_size=32, num_layers=1, num_heads=4,
                              d_model=32, d_ff=64, max_seq_len=32,

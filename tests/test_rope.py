@@ -71,6 +71,7 @@ def test_rope_flash_matches_local():
         atol=3e-5, rtol=3e-5)
 
 
+@pytest.mark.slow  # ~10s: naive reference decode loop (tier-1 duration budget); rope_swiglu_decode_matches_full_forward stays fast
 def test_rope_generate_matches_naive_and_int8_cache():
     cfg = TransformerConfig(**KW)
     m = Transformer(cfg)

@@ -142,6 +142,27 @@ def test_bench_serve_paged_concurrency_at_fixed_hbm(tmp_path):
 
 
 @pytest.mark.slow
+def test_bench_serve_tp_paged_ab(tmp_path):
+    """The tensor-parallel serving acceptance row (serve_tp_paged,
+    docs/parallel.md): a tp=2 paged engine is token-identical to tp=1
+    on the same mixed workload, and at a FIXED per-shard KV byte
+    budget (each shard's blocks are half the bytes, so the same
+    per-device budget buys 2x blocks) it sustains >= 1.3x the
+    concurrent residency.  Wall-clock is archived, not asserted — two
+    shard loops on a 2-vCPU host measure overhead, not the mesh."""
+    import bench_serve
+
+    row = bench_serve.tp_ab(
+        long_reqs=2, long_len=96, short_reqs=10, short_len=16,
+        tokens=32, slots=12, base_slots=1, d_model=128, layers=2,
+        max_seq=128, chunk=32,
+        out_path=str(tmp_path / "BENCH_SERVE.json"))
+    assert row["mismatches"] == 0
+    assert row["concurrency_ratio"] >= 1.3, row
+    assert row["tp_blocks"] == 2 * row["tp1_blocks"]
+
+
+@pytest.mark.slow
 def test_bench_serve_paged_kernel_ab(tmp_path):
     """The fused-kernel acceptance row (serve_paged_kernel): kernel-on
     decode is token-identical to the gather path and never gathers,

@@ -239,6 +239,7 @@ def _run(model, variables, prompts, m, *, kv_dtype="int8", seed0=3,
     return [np.asarray(r.result()) for r in reqs], eng
 
 
+@pytest.mark.slow  # ~9s, >20s under load (tier-1 duration budget); kernel_matches_dequantized_gather_int8[1/2/5] keeps kernel-vs-gather parity fast
 def test_engine_int8_kernel_vs_gather_parity_and_rerun(tiny, prompts):
     """The int8 acceptance anchor: fused-kernel (interpret) and
     gather-fallback engines emit IDENTICAL token streams from an int8
@@ -264,6 +265,7 @@ def test_engine_int8_kernel_vs_gather_parity_and_rerun(tiny, prompts):
     assert eng_g.pool.kv_dtype == "int8"
 
 
+@pytest.mark.slow  # ~8s (tier-1 duration budget); int8 pool sizing stays fast and test_serving_paged covers preemption fast
 def test_engine_int8_preempt_resume_parity(tiny):
     """Preempt/resume on quantized shared storage: under block
     pressure the victim re-prefills and must reproduce the ORIGINAL
@@ -285,6 +287,7 @@ def test_engine_int8_preempt_resume_parity(tiny):
     assert eng.pool.alloc.used_count == 1
 
 
+@pytest.mark.slow  # ~10s, >20s under load (tier-1 duration budget); test_serve_blocks COW-fork tests keep the fork semantics fast
 def test_engine_int8_cow_on_quantized_shared_blocks(tiny):
     """COW forks quantized shared blocks whole — s8 values AND scale
     rows ride in one generic fork program.  With min_prefill_bucket=16
@@ -386,6 +389,7 @@ def test_radix_store_partial_insert_and_leaf_only_eviction():
     assert alloc.used_count == 6  # only the callers' own alloc refs
 
 
+@pytest.mark.slow  # ~8s, >20s under load (tier-1 duration budget); the radix-store chain tests keep block-boundary sharing fast
 def test_engine_radix_share_without_single_entry_insert(tiny):
     """The acceptance pin: C shares a 4-block prefix assembled from TWO
     different requests' inserts (never one entry) — its admit hit
